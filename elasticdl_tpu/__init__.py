@@ -1,0 +1,20 @@
+"""elasticdl-tpu: a TPU-native elastic distributed training framework.
+
+A from-scratch rebuild of the capabilities of ElasticDL
+(workingloong/elasticdl) designed TPU-first:
+
+- the reference's TF2-eager parameter-server and Horovod/NCCL AllReduce
+  data-parallel paths are replaced by XLA-compiled JAX train steps whose
+  gradients are reduced with mesh collectives over ICI;
+- the gRPC parameter server (Python + Go/Eigen) is replaced by sharded
+  on-device state: dense params via NamedSharding/pjit, sparse embedding
+  tables sharded across the mesh with id-hash routing (shard_map);
+- the Master's dynamic data-shard task dispatcher, shard-rerun fault
+  tolerance, Kubernetes pod management, evaluation service and elastic
+  rendezvous are preserved as a pure-Python gRPC control plane;
+- checkpointing is Orbax (async, sharded, preemption-aware).
+
+See SURVEY.md at the repo root for the component-by-component mapping.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,178 @@
+"""The shared argparse surface.
+
+Parity: reference python/common/args.py (SURVEY.md C21).  As in the
+reference, one flag namespace is shared by client -> master -> worker and
+argv is the config wire format: the client re-serializes parsed flags into
+the master pod command, the master into worker commands
+(`build_arguments_from_parsed_result`).
+"""
+
+from __future__ import annotations
+
+import argparse
+from itertools import chain
+
+
+def pos_int(value):
+    ivalue = int(value)
+    if ivalue <= 0:
+        raise argparse.ArgumentTypeError(f"{value} is not a positive integer")
+    return ivalue
+
+
+def non_neg_int(value):
+    ivalue = int(value)
+    if ivalue < 0:
+        raise argparse.ArgumentTypeError(f"{value} is negative")
+    return ivalue
+
+
+def str2bool(value):
+    if isinstance(value, bool):
+        return value
+    if value.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if value.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"Boolean value expected, got {value}")
+
+
+def add_common_params(parser: argparse.ArgumentParser):
+    parser.add_argument(
+        "--job_name", default="elasticdl-job", help="Job / pod-name prefix"
+    )
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--distribution_strategy",
+        default="AllReduce",
+        choices=["Local", "AllReduce", "ParameterServer"],
+        help="ParameterServer is accepted for reference-CLI compatibility "
+        "and maps onto the sharded-mesh path (no PS pods on TPU).",
+    )
+    parser.add_argument("--master_addr", default="", help="host:port of master")
+    parser.add_argument("--port", type=pos_int, default=50001)
+    parser.add_argument("--num_workers", type=pos_int, default=1)
+    parser.add_argument("--num_minibatches_per_task", type=pos_int, default=8)
+    parser.add_argument("--log_level", default="INFO")
+    parser.add_argument("--image_name", default="")
+    parser.add_argument("--worker_resource_request", default="cpu=1,memory=4096Mi")
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument("--restart_policy", default="Never")
+    parser.add_argument("--volume", default="")
+    parser.add_argument("--image_pull_policy", default="IfNotPresent")
+    parser.add_argument(
+        "--need_tf_config", type=str2bool, default=False, nargs="?", const=True
+    )
+
+
+def add_model_params(parser: argparse.ArgumentParser):
+    parser.add_argument(
+        "--model_zoo", required=False, default="model_zoo",
+        help="Directory containing model definitions",
+    )
+    parser.add_argument(
+        "--model_def", required=False, default="",
+        help="module.function returning the model, e.g. "
+        "mnist.mnist_functional_api.custom_model",
+    )
+    parser.add_argument("--model_params", default="", help="free-form kwargs")
+    parser.add_argument("--dataset_fn", default="feed")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument("--custom_data_reader", default="custom_data_reader")
+    parser.add_argument("--prediction_outputs_processor", default="")
+    parser.add_argument("--callbacks", default="callbacks")
+
+
+def add_train_params(parser: argparse.ArgumentParser):
+    parser.add_argument("--minibatch_size", type=pos_int, default=64)
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument("--grads_to_wait", type=pos_int, default=1)
+    parser.add_argument("--training_data", default="")
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--evaluation_steps", type=non_neg_int, default=0)
+    parser.add_argument("--evaluation_start_delay_secs", type=non_neg_int, default=0)
+    parser.add_argument("--evaluation_throttle_secs", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
+    parser.add_argument("--output", default="", help="final model export dir")
+    parser.add_argument(
+        "--checkpoint_dir_for_init", default="",
+        help="checkpoint to warm-start from",
+    )
+    parser.add_argument("--task_fault_tolerance", type=str2bool, default=True)
+    parser.add_argument(
+        "--relaunch_on_worker_failure", type=non_neg_int, default=3,
+        help="max relaunches per failed worker pod",
+    )
+    parser.add_argument("--use_bf16", type=str2bool, default=True,
+                        help="compute in bfloat16 on the MXU where safe")
+    parser.add_argument("--data_reader_params", default="")
+    parser.add_argument("--records_per_task", type=pos_int, default=4096)
+    parser.add_argument(
+        "--task_lease_timeout_s", type=pos_int, default=900,
+        help="re-queue a leased task if not reported within this window",
+    )
+
+
+def add_evaluate_params(parser):
+    parser.add_argument("--minibatch_size", type=pos_int, default=64)
+    parser.add_argument("--validation_data", default="")
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--records_per_task", type=pos_int, default=4096)
+    parser.add_argument("--data_reader_params", default="")
+
+
+def add_predict_params(parser):
+    parser.add_argument("--minibatch_size", type=pos_int, default=64)
+    parser.add_argument("--prediction_data", default="")
+    parser.add_argument("--checkpoint_dir_for_init", default="")
+    parser.add_argument("--records_per_task", type=pos_int, default=4096)
+    parser.add_argument("--data_reader_params", default="")
+
+
+def parse_master_args(argv=None):
+    parser = argparse.ArgumentParser(description="elasticdl-tpu master")
+    add_common_params(parser)
+    add_model_params(parser)
+    add_train_params(parser)
+    parser.add_argument("--job_type", default="train",
+                        choices=["train", "evaluate", "predict"])
+    args, _ = parser.parse_known_args(argv)
+    return args
+
+
+def parse_worker_args(argv=None):
+    parser = argparse.ArgumentParser(description="elasticdl-tpu worker")
+    add_common_params(parser)
+    add_model_params(parser)
+    add_train_params(parser)
+    parser.add_argument("--worker_id", type=int, default=0)
+    parser.add_argument("--job_type", default="train")
+    args, _ = parser.parse_known_args(argv)
+    return args
+
+
+def build_arguments_from_parsed_result(args, filter_args=None) -> list:
+    """Re-serialize a parsed namespace back into argv (the config wire
+    format between client -> master -> worker pods, as in the reference)."""
+    items = vars(args).items()
+    if filter_args:
+        items = [(k, v) for k, v in items if k not in filter_args]
+    arguments = []
+    for key, value in items:
+        if value is None or value == "":
+            continue
+        arguments.append("--" + key)
+        arguments.append(str(value))
+    return arguments
+
+
+def wrap_python_args_with_string(args: list) -> list:
+    """Quote values so argv survives a shell boundary in a pod command."""
+    return list(chain.from_iterable(
+        (a,) if a.startswith("--") else (f"'{a}'",) for a in args
+    ))

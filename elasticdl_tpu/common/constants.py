@@ -1,0 +1,49 @@
+"""Shared constants.  Parity: reference python/common/constants.py
+(SURVEY.md C22)."""
+
+
+class PodStatus:
+    INITIAL = "Initial"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    DELETED = "Deleted"
+    UNKNOWN = "Unknown"
+
+
+class PodType:
+    MASTER = "master"
+    WORKER = "worker"
+
+
+class JobStatus:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class TaskExecCounterKey:
+    FAIL_COUNT = "fail_count"
+    RECORDS = "records"
+
+
+class DistributionStrategy:
+    LOCAL = "Local"               # single process, in-process master
+    ALLREDUCE = "AllReduce"       # elastic DP over the device mesh (psum)
+    PARAMETER_SERVER = "ParameterServer"  # accepted for reference CLI
+    # compatibility; maps onto the mesh path (no PS processes on TPU).
+
+
+class WorkerEnv:
+    MASTER_ADDR = "ELASTICDL_MASTER_ADDR"
+    WORKER_ID = "ELASTICDL_WORKER_ID"
+
+
+# Default lease duration before a "doing" task is considered abandoned and
+# re-queued even without a pod-failure event (belt-and-braces on top of the
+# k8s watch failure detector).
+DEFAULT_TASK_LEASE_TIMEOUT_S = 15 * 60
+
+GRPC_MAX_MESSAGE_LENGTH = 32 * 1024 * 1024

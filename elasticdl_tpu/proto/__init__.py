@@ -1,0 +1,20 @@
+import os
+import subprocess
+
+_HERE = os.path.dirname(__file__)
+
+
+def _ensure_generated():
+    """Regenerate elasticdl_pb2.py from the .proto if missing or stale."""
+    proto = os.path.join(_HERE, "elasticdl.proto")
+    gen = os.path.join(_HERE, "elasticdl_pb2.py")
+    if not os.path.exists(gen) or os.path.getmtime(gen) < os.path.getmtime(proto):
+        subprocess.run(
+            ["protoc", f"--python_out={_HERE}", f"--proto_path={_HERE}", proto],
+            check=True,
+        )
+
+
+_ensure_generated()
+
+from elasticdl_tpu.proto import elasticdl_pb2  # noqa: E402,F401

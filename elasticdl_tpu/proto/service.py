@@ -1,0 +1,88 @@
+"""Hand-written gRPC service glue for the Master control-plane service.
+
+grpcio-tools is unavailable in this environment, so instead of generated
+`_pb2_grpc.py` service classes we register the service with grpc's generic
+handler API and build client stubs from `channel.unary_unary`.  The wire
+format (method paths, protobuf request/response types) is identical to what
+`protoc --grpc_python_out` would have produced for the `Master` service
+declared in elasticdl.proto.
+
+Parity: reference `elasticdl/proto/elasticdl.proto` service `Master`
+(SURVEY.md C1/C2).  The `Pserver` service is intentionally absent — tensor
+traffic lives on the device mesh in the TPU-native design.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+# method name -> (request class, response class)
+MASTER_METHODS = {
+    "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+    "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
+    "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
+    "get_cluster_spec": (pb.GetClusterSpecRequest, pb.ClusterSpec),
+    "keep_alive": (pb.KeepAliveRequest, pb.Empty),
+    "report_version": (pb.ReportVersionRequest, pb.Empty),
+}
+
+
+def add_master_servicer_to_server(servicer, server) -> None:
+    """Register `servicer` (an object with MASTER_METHODS-named methods
+    accepting (request, context)) on a `grpc.Server`."""
+    import grpc
+
+    handlers = {}
+    for name, (req_cls, resp_cls) in MASTER_METHODS.items():
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg, _cls=resp_cls: msg.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class MasterStub:
+    """Client stub over a grpc channel; method-for-method mirror of the
+    servicer so `InProcessMasterClient` (direct servicer calls, used by the
+    tests and local mode) and this stub are interchangeable."""
+
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls) in MASTER_METHODS.items():
+            callable_ = channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+            setattr(self, name, _StripContext(callable_))
+
+
+class _StripContext:
+    """Adapts stub(request) to the servicer-side (request, context) shape so
+    both transports expose `fn(request)`."""
+
+    def __init__(self, callable_):
+        self._callable = callable_
+
+    def __call__(self, request, timeout=None):
+        return self._callable(request, timeout=timeout)
+
+
+class InProcessMasterClient:
+    """Calls a MasterServicer directly, no sockets.  Used by tests and by
+    `--distribution_strategy=Local` where master and worker share a process
+    (the reference exercises its protocol the same way in
+    worker_ps_interaction_test.py — SURVEY.md §4.2)."""
+
+    def __init__(self, servicer):
+        for name in MASTER_METHODS:
+            method = getattr(servicer, name)
+            setattr(
+                self,
+                name,
+                lambda request, timeout=None, _m=method: _m(request, None),
+            )

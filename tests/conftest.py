@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Must run before anything imports jax: forces an 8-device virtual CPU mesh so
+all multi-chip sharding paths (DP psum, sharded embeddings, ring attention)
+execute in CI without TPUs — the strategy SURVEY.md §4 prescribes for the
+rebuild (the reference's analogue is its in-process multi-role tests with a
+mocked k8s layer).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

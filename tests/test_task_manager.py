@@ -1,0 +1,146 @@
+"""Task manager unit tests: create / lease / report / recover / expire /
+epoch semantics — the behaviors the reference covers in
+task_manager_test.py (SURVEY.md §4.1)."""
+
+import pytest
+
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    create_shards_from_ranges,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def make_tm(records=100, per_task=10, **kw):
+    shards = create_shards_from_ranges([("f1", 0, records)], per_task)
+    return TaskManager(training_shards=shards, **kw)
+
+
+def test_create_shards_ranges():
+    shards = create_shards_from_ranges([("a", 0, 25), ("b", 5, 11)], 10)
+    assert [(s.name, s.start, s.end) for s in shards] == [
+        ("a", 0, 10), ("a", 10, 20), ("a", 20, 25), ("b", 5, 11),
+    ]
+
+
+def test_lease_and_report_success():
+    tm = make_tm()
+    task = tm.get(worker_id=0)
+    assert task is not None and task.type == pb.TRAINING
+    assert tm.report(task.task_id, success=True, records=10)
+    snap = tm.snapshot()
+    assert snap["counters"]["finished"] == 1
+    assert snap["counters"]["records_done"] == 10
+
+
+def test_all_tasks_unique_and_exhaustive():
+    tm = make_tm(records=100, per_task=10)
+    seen = []
+    while True:
+        task = tm.get(worker_id=0)
+        if task is None:
+            break
+        seen.append((task.shard.name, task.shard.start, task.shard.end))
+        tm.report(task.task_id, success=True)
+    assert len(seen) == 10
+    assert len(set(seen)) == 10
+    assert tm.finished
+
+
+def test_failed_task_requeued_with_retry_limit():
+    shards = create_shards_from_ranges([("f", 0, 10)], 10)
+    tm = TaskManager(training_shards=shards, max_task_retries=2)
+    for attempt in range(3):
+        task = tm.get(worker_id=0)
+        if attempt < 3 - 1:
+            assert task is not None
+        tm.report(task.task_id, success=False)
+    # retries exhausted -> dropped -> no more tasks, job finishes
+    assert tm.get(worker_id=0) is None
+    assert tm.finished
+
+
+def test_recover_tasks_requeues_only_dead_workers_tasks():
+    tm = make_tm(records=30, per_task=10)
+    t0 = tm.get(worker_id=0)
+    t1 = tm.get(worker_id=1)
+    t2 = tm.get(worker_id=0)
+    assert tm.recover_tasks(worker_id=0) == 2
+    # worker 1's lease is untouched
+    assert tm.snapshot()["doing"] == 1
+    # recovered tasks come back at the front
+    back = tm.get(worker_id=2)
+    assert back.task_id in (t0.task_id, t2.task_id)
+    assert t1.task_id not in (back.task_id,)
+
+
+def test_lease_expiry_reaps_and_requeues():
+    tm = make_tm(records=10, per_task=10, lease_timeout_s=100)
+    task = tm.get(worker_id=0)
+    assert tm.reap_expired_tasks(now=task and 0) == 0  # fresh lease
+    import time
+    assert tm.reap_expired_tasks(now=time.time() + 101) == 1
+    assert tm.snapshot()["todo"] == 1
+    # stale report after reap is ignored
+    assert not tm.report(task.task_id, success=True)
+
+
+def test_epochs_recreate_training_tasks():
+    shards = create_shards_from_ranges([("f", 0, 20)], 10)
+    tm = TaskManager(training_shards=shards, num_epochs=3)
+    count = 0
+    while True:
+        task = tm.get(worker_id=0)
+        if task is None:
+            break
+        count += 1
+        tm.report(task.task_id, success=True)
+    assert count == 2 * 3
+    assert tm.finished
+
+
+def test_eval_tasks_jump_queue_and_callbacks_fire():
+    shards = create_shards_from_ranges([("f", 0, 20)], 10)
+    eval_shards = create_shards_from_ranges([("val", 0, 10)], 10)
+    tm = TaskManager(training_shards=shards, evaluation_shards=eval_shards)
+    done = []
+    tm.add_completion_callback(lambda task, ok: done.append((task.type, ok)))
+    finished = []
+    tm.add_all_done_callback(lambda: finished.append(True))
+    tm.create_evaluation_tasks(model_version=7)
+    task = tm.get(worker_id=0)
+    assert task.type == pb.EVALUATION and task.model_version == 7
+    tm.report(task.task_id, success=True)
+    while True:
+        t = tm.get(worker_id=0)
+        if t is None:
+            break
+        tm.report(t.task_id, success=True)
+    assert (pb.EVALUATION, True) in done
+    assert finished == [True]
+
+
+def test_get_by_task_type():
+    tm = make_tm(records=10, per_task=10)
+    tm.create_evaluation_tasks(model_version=1)
+    train = tm.get(worker_id=0, task_type=pb.TRAINING)
+    assert train.type == pb.TRAINING
+
+
+def test_shuffle_is_deterministic_with_seed():
+    shards = create_shards_from_ranges([("f", 0, 100)], 10)
+    orders = []
+    for _ in range(2):
+        tm = TaskManager(
+            training_shards=shards, shuffle_shards=True, shuffle_seed=42
+        )
+        order = []
+        while True:
+            t = tm.get(0)
+            if t is None:
+                break
+            order.append(t.shard.start)
+            tm.report(t.task_id, True)
+        orders.append(order)
+    assert orders[0] == orders[1]
+    assert orders[0] != sorted(orders[0])  # actually shuffled
