@@ -1,0 +1,117 @@
+"""Model-zoo contract loading.
+
+Parity: reference python/common/model_handler.py + model_utils.py
+(SURVEY.md C14).  The zoo contract keeps the reference's function names so
+model definitions port by re-implementing bodies in Flax/Optax:
+
+    custom_model()            -> flax.linen Module (predictions = apply())
+    loss(labels, predictions) -> scalar jnp loss
+    optimizer(lr=...)         -> optax.GradientTransformation
+    feed(records, metadata)   -> batch dict {"features":..., "labels":...}
+    eval_metrics_fn()         -> {name: fn(labels, predictions) -> scalar}
+    custom_data_reader(**kw)  -> AbstractDataReader (optional)
+    callbacks()               -> list (optional)
+    param_sharding(path,leaf) -> PartitionSpec | None (optional; TPU-native
+                                 extension for sharded embeddings / TP)
+
+The reference's ModelHandler also rewrote `elasticdl.Embedding` <->
+`keras.Embedding` depending on distribution strategy; in the TPU design
+DistributedEmbedding is mesh-sharded transparently, so export needs no
+layer rewrite — see layers/embedding.py.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class ModelSpec:
+    model: Any
+    loss: Callable
+    optimizer: Any
+    feed: Callable
+    eval_metrics: Dict[str, Callable] = field(default_factory=dict)
+    custom_data_reader: Optional[Callable] = None
+    callbacks: list = field(default_factory=list)
+    param_sharding: Optional[Callable] = None
+    module: Any = None
+
+
+def load_module(model_zoo: str, dotted: str):
+    """Resolve `pkg.module.fn` relative to the model_zoo directory; returns
+    (module, function)."""
+    model_zoo = os.path.abspath(model_zoo)
+    if model_zoo not in sys.path:
+        sys.path.insert(0, model_zoo)
+    module_path, fn_name = dotted.rsplit(".", 1)
+    module = importlib.import_module(module_path)
+    return module, getattr(module, fn_name)
+
+
+def _call_with_params(fn, params: str):
+    """Call fn, passing parsed `--model_params`-style 'k=v;k2=v2' kwargs
+    that match its signature."""
+    kwargs = {}
+    if params:
+        for item in params.split(";"):
+            if not item.strip():
+                continue
+            key, _, value = item.partition("=")
+            try:
+                value = eval(value, {"__builtins__": {}})  # noqa: S307
+            except Exception:
+                pass
+            kwargs[key.strip()] = value
+    sig = inspect.signature(fn)
+    accepted = {
+        k: v for k, v in kwargs.items() if k in sig.parameters
+    }
+    return fn(**accepted)
+
+
+def get_model_spec(
+    model_zoo: str,
+    model_def: str,
+    model_params: str = "",
+    dataset_fn: str = "feed",
+    loss: str = "loss",
+    optimizer: str = "optimizer",
+    eval_metrics_fn: str = "eval_metrics_fn",
+    custom_data_reader: str = "custom_data_reader",
+    callbacks: str = "callbacks",
+) -> ModelSpec:
+    module, model_fn = load_module(model_zoo, model_def)
+
+    def opt(name, required=True):
+        fn = getattr(module, name, None)
+        if fn is None and required:
+            raise ValueError(
+                f"model zoo module {module.__name__} lacks required "
+                f"function {name}()"
+            )
+        return fn
+
+    metrics_factory = opt(eval_metrics_fn, required=False)
+    reader_factory = opt(custom_data_reader, required=False)
+    callbacks_factory = opt(callbacks, required=False)
+    return ModelSpec(
+        model=_call_with_params(model_fn, model_params),
+        loss=opt(loss),
+        optimizer=_call_with_params(opt(optimizer), model_params),
+        feed=opt(dataset_fn),
+        eval_metrics=metrics_factory() if metrics_factory else {},
+        custom_data_reader=reader_factory,
+        callbacks=callbacks_factory() if callbacks_factory else [],
+        param_sharding=getattr(module, "param_sharding", None),
+        module=module,
+    )

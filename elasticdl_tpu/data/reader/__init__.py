@@ -1,0 +1,24 @@
+"""Reader factory.  Parity: reference data reader creation from
+--training_data + --data_reader_params (SURVEY.md C12)."""
+
+from elasticdl_tpu.data.reader.base import AbstractDataReader  # noqa: F401
+from elasticdl_tpu.data.reader.csv_reader import CSVDataReader  # noqa: F401
+from elasticdl_tpu.data.reader.memory_reader import MemoryDataReader  # noqa: F401
+from elasticdl_tpu.data.reader.tfrecord_reader import (  # noqa: F401
+    TFRecordDataReader,
+)
+
+
+def create_data_reader(data_origin: str, **kwargs) -> AbstractDataReader:
+    """Pick a reader from the data path: .csv -> CSV, else TFRecord.
+    Custom readers come from the model-zoo module's `custom_data_reader`
+    (handled by the model handler, not here)."""
+    if data_origin.endswith(".csv") or kwargs.pop("reader_type", "") == "csv":
+        return CSVDataReader(data_dir=data_origin, **kwargs)
+    import os
+
+    if os.path.isdir(data_origin):
+        entries = os.listdir(data_origin)
+        if entries and all(e.endswith(".csv") for e in entries):
+            return CSVDataReader(data_dir=data_origin, **kwargs)
+    return TFRecordDataReader(data_dir=data_origin, **kwargs)

@@ -1,0 +1,41 @@
+"""Data reader contract.
+
+Parity: reference python/data/reader/ `AbstractDataReader` — SURVEY.md C12.
+A reader makes a data source *shard-addressable*: `create_shards()`
+enumerates (name, start, end) ranges the master cuts into tasks, and
+`read_records(task)` yields the raw records of one leased task on a worker.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator, List, Tuple
+
+Metadata = dict
+
+
+class AbstractDataReader(abc.ABC):
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    @abc.abstractmethod
+    def read_records(self, task) -> Iterator:
+        """Yield records for task.shard ([start, end) of shard.name)."""
+
+    @abc.abstractmethod
+    def create_shards(self) -> List[Tuple[str, int, int]]:
+        """Enumerate (source_name, start, end) ranges covering the data."""
+
+    @property
+    def records_output_types(self):
+        return bytes
+
+    @property
+    def metadata(self) -> Metadata:
+        return {}
+
+
+def check_required_kwargs(required, kwargs):
+    missing = [k for k in required if k not in kwargs]
+    if missing:
+        raise ValueError(f"data reader missing required kwargs: {missing}")

@@ -1,0 +1,53 @@
+"""Shard-addressable CSV reader (SURVEY.md C12 parity with the reference's
+text/ODPS table readers: a record is one data row)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterator, List, Tuple
+
+from elasticdl_tpu.data.reader.base import AbstractDataReader
+
+
+class CSVDataReader(AbstractDataReader):
+    def __init__(self, data_dir: str, columns: List[str] = None,
+                 sep: str = ",", has_header: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self._data_dir = data_dir
+        self._sep = sep
+        self._has_header = has_header
+        self._columns = columns
+        self._row_cache = {}
+
+    def _files(self) -> List[str]:
+        if os.path.isfile(self._data_dir):
+            return [self._data_dir]
+        return sorted(
+            os.path.join(self._data_dir, f)
+            for f in os.listdir(self._data_dir)
+            if f.endswith(".csv")
+        )
+
+    def _rows(self, name: str) -> list:
+        if name not in self._row_cache:
+            with open(name, newline="") as f:
+                rows = list(csv.reader(f, delimiter=self._sep))
+            if self._has_header and rows:
+                header, rows = rows[0], rows[1:]
+                if self._columns is None:
+                    self._columns = header
+            self._row_cache[name] = rows
+        return self._row_cache[name]
+
+    def read_records(self, task) -> Iterator[list]:
+        rows = self._rows(task.shard.name)
+        for i in range(task.shard.start, min(task.shard.end, len(rows))):
+            yield rows[i]
+
+    def create_shards(self) -> List[Tuple[str, int, int]]:
+        return [(f, 0, len(self._rows(f))) for f in self._files()]
+
+    @property
+    def metadata(self):
+        return {"columns": self._columns}

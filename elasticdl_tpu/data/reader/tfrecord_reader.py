@@ -1,0 +1,41 @@
+"""Shard-addressable TFRecord directory reader (SURVEY.md C12 —
+TPU-native stand-in for the reference's RecordIODataReader)."""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Tuple
+
+from elasticdl_tpu.data.record_io import TFRecordReader
+from elasticdl_tpu.data.reader.base import AbstractDataReader
+
+
+class TFRecordDataReader(AbstractDataReader):
+    """Reads a directory of (or a single) .tfrecord file(s); shard name is
+    the file path, record addressing via the sidecar offset index."""
+
+    def __init__(self, data_dir: str, **kwargs):
+        super().__init__(**kwargs)
+        self._data_dir = data_dir
+        self._readers = {}
+
+    def _files(self) -> List[str]:
+        if os.path.isfile(self._data_dir):
+            return [self._data_dir]
+        return sorted(
+            os.path.join(self._data_dir, f)
+            for f in os.listdir(self._data_dir)
+            if not f.endswith(".idx")
+        )
+
+    def _reader(self, name: str) -> TFRecordReader:
+        if name not in self._readers:
+            self._readers[name] = TFRecordReader(name)
+        return self._readers[name]
+
+    def read_records(self, task) -> Iterator[bytes]:
+        reader = self._reader(task.shard.name)
+        yield from reader.read(task.shard.start, task.shard.end)
+
+    def create_shards(self) -> List[Tuple[str, int, int]]:
+        return [(f, 0, len(self._reader(f))) for f in self._files()]

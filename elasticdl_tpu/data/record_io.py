@@ -1,0 +1,193 @@
+"""Pure-Python TFRecord container IO with a random-access offset index.
+
+The reference's data plane is shard-addressable RecordIO files (pyrecordio)
+— SURVEY.md C12.  On TPU the equivalent container is TFRecord (what
+tf.data/ArrayRecord pipelines consume); this module implements the TFRecord
+wire format without importing TensorFlow so the data layer stays light:
+
+    each record:  uint64 length (LE) | uint32 masked-crc32c(length)
+                  | payload bytes    | uint32 masked-crc32c(payload)
+
+TFRecord has no native random access, so shard-addressability (a task is
+"file + record range") is provided by a sidecar offset index built on first
+use and cached next to the file (`<file>.idx`, one uint64 offset per
+record).  A C++ fast path for scanning/parsing lives in native/ (see
+elasticdl_tpu.data.native_io) and is used automatically when built.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional
+
+# ---- crc32c (Castagnoli), table-driven ---------------------------------
+
+_CRC_TABLE = []
+
+
+def _build_table():
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_build_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for byte in data:
+        crc = _CRC_TABLE[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---- writer ------------------------------------------------------------
+
+
+class TFRecordWriter:
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_tfrecords(path: str, payloads) -> int:
+    with TFRecordWriter(path) as writer:
+        n = 0
+        for payload in payloads:
+            writer.write(payload)
+            n += 1
+    return n
+
+
+# ---- reader + index ----------------------------------------------------
+
+
+def _try_native():
+    try:
+        from elasticdl_tpu.data import native_io
+
+        return native_io if native_io.available() else None
+    except Exception:
+        return None
+
+
+def build_index(path: str) -> List[int]:
+    """Scan the file once, returning the byte offset of every record."""
+    native = _try_native()
+    if native is not None:
+        return native.build_index(path)
+    offsets = []
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        while pos < size:
+            offsets.append(pos)
+            header = f.read(8)
+            if len(header) < 8:
+                raise IOError(f"{path}: truncated record header at {pos}")
+            (length,) = struct.unpack("<Q", header)
+            pos += 8 + 4 + length + 4
+            f.seek(pos)
+    return offsets
+
+
+def _index_path(path: str) -> str:
+    return path + ".idx"
+
+
+_IDX_MAGIC = 0x454C4458  # "ELDX"
+
+
+def load_or_build_index(path: str, cache: bool = True) -> List[int]:
+    """The sidecar index carries a header (magic, data-file size, record
+    count) validated against the data file, so an in-place regeneration of
+    the .tfrecord within mtime granularity cannot serve stale offsets."""
+    idx = _index_path(path)
+    data_size = os.path.getsize(path)
+    if (
+        os.path.exists(idx)
+        and os.path.getmtime(idx) >= os.path.getmtime(path)
+    ):
+        try:
+            with open(idx, "rb") as f:
+                blob = f.read()
+            magic, size, count = struct.unpack("<IQQ", blob[:20])
+            if magic == _IDX_MAGIC and size == data_size:
+                offsets = list(struct.unpack(f"<{count}Q", blob[20:]))
+                if not offsets or offsets[-1] < data_size:
+                    return offsets
+        except (struct.error, ValueError):
+            pass  # corrupt index: rebuild below
+    offsets = build_index(path)
+    if cache:
+        try:
+            with open(idx, "wb") as f:
+                f.write(struct.pack("<IQQ", _IDX_MAGIC, data_size, len(offsets)))
+                f.write(struct.pack(f"<{len(offsets)}Q", *offsets))
+        except OSError:
+            pass  # read-only data dir: index stays in memory
+    return offsets
+
+
+class TFRecordReader:
+    """Random-access reader over an indexed TFRecord file."""
+
+    def __init__(self, path: str, check_crc: bool = False,
+                 cache_index: bool = True):
+        self._path = path
+        self._check_crc = check_crc
+        self._offsets = load_or_build_index(path, cache=cache_index)
+        self._f = open(path, "rb")
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def read(self, start: int, end: Optional[int] = None) -> Iterator[bytes]:
+        """Yield payloads for records in [start, end)."""
+        end = len(self._offsets) if end is None else min(end, len(self._offsets))
+        for i in range(start, end):
+            self._f.seek(self._offsets[i])
+            header = self._f.read(8)
+            (length,) = struct.unpack("<Q", header)
+            stored_hdr_crc = struct.unpack("<I", self._f.read(4))[0]
+            payload = self._f.read(length)
+            stored_crc = struct.unpack("<I", self._f.read(4))[0]
+            if self._check_crc:
+                if stored_hdr_crc != _masked_crc(header):
+                    raise IOError(f"{self._path}: header CRC mismatch @record {i}")
+                if stored_crc != _masked_crc(payload):
+                    raise IOError(f"{self._path}: payload CRC mismatch @record {i}")
+            yield payload
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

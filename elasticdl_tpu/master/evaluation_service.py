@@ -1,0 +1,109 @@
+"""Evaluation service: schedules eval tasks and aggregates worker metrics.
+
+Parity: reference python/master/evaluation_service.py (SURVEY.md C5, call
+stack §3.5).  Eval tasks ride the same task queue as training; workers run
+forward-only over the shard and report per-shard metric means weighted by
+example count; the master reduces them into job-level metrics per model
+version.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+
+class _VersionAgg:
+    def __init__(self):
+        self.weighted_sums: Dict[str, float] = {}
+        self.num_examples = 0
+
+    def add(self, metrics: Dict[str, float], n: int):
+        for name, value in metrics.items():
+            self.weighted_sums[name] = (
+                self.weighted_sums.get(name, 0.0) + value * n
+            )
+        self.num_examples += n
+
+    def result(self) -> Dict[str, float]:
+        if not self.num_examples:
+            return {}
+        return {
+            k: v / self.num_examples for k, v in self.weighted_sums.items()
+        }
+
+
+class EvaluationService:
+    def __init__(
+        self,
+        task_manager,
+        evaluation_steps: int = 0,
+        start_delay_secs: int = 0,
+        throttle_secs: int = 0,
+        eval_only_at_end: bool = False,
+    ):
+        self._tm = task_manager
+        self._evaluation_steps = evaluation_steps
+        self._start_delay_secs = start_delay_secs
+        self._throttle_secs = throttle_secs
+        self._eval_only_at_end = eval_only_at_end
+        self._lock = threading.Lock()
+        self._aggs: Dict[int, _VersionAgg] = {}
+        self._last_eval_version = 0
+        self._last_eval_time = 0.0
+        self._start_time = time.time()
+        self.history: Dict[int, Dict[str, float]] = {}
+        if eval_only_at_end:
+            task_manager.add_all_done_callback(self._on_all_done)
+
+    # ---- scheduling ----------------------------------------------------
+
+    def on_version_report(self, model_version: int):
+        """Called by the servicer when a worker reports progress; decides
+        whether to inject eval tasks (version-interval + throttle gates, as
+        in the reference)."""
+        if self._eval_only_at_end or not self._evaluation_steps:
+            return
+        now = time.time()
+        with self._lock:
+            if now - self._start_time < self._start_delay_secs:
+                return
+            if model_version - self._last_eval_version < self._evaluation_steps:
+                return
+            if now - self._last_eval_time < self._throttle_secs:
+                return
+            self._last_eval_version = model_version
+            self._last_eval_time = now
+        n = self._tm.create_evaluation_tasks(model_version)
+        logger.info(
+            "Injected %d eval tasks at model version %d", n, model_version
+        )
+
+    def _on_all_done(self):
+        # Final evaluation is injected by the master main loop, which knows
+        # whether a validation set exists; hook kept for symmetry.
+        pass
+
+    # ---- aggregation ---------------------------------------------------
+
+    def report_metrics(self, req: pb.ReportEvaluationMetricsRequest):
+        with self._lock:
+            agg = self._aggs.setdefault(req.model_version, _VersionAgg())
+            agg.add(dict(req.metrics), req.num_examples or 1)
+            self.history[req.model_version] = agg.result()
+        logger.info(
+            "Eval metrics v%d (n=%d): %s",
+            req.model_version, agg.num_examples, self.history[req.model_version],
+        )
+
+    def latest_metrics(self) -> Optional[Dict[str, float]]:
+        with self._lock:
+            if not self.history:
+                return None
+            return self.history[max(self.history)]

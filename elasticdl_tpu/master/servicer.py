@@ -1,0 +1,92 @@
+"""Master gRPC servicer: the job brain's RPC surface.
+
+Parity: reference python/master/servicer.py (SURVEY.md C2).  Handlers are
+O(µs): they only touch the task queue / metric dicts — never tensors (the
+control/data-plane split the reference establishes and this rebuild keeps).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager: TaskManager,
+        evaluation_service=None,
+        rendezvous_server=None,
+        pod_manager=None,
+    ):
+        self._tm = task_manager
+        self._eval = evaluation_service
+        self._rendezvous = rendezvous_server
+        self._pod_manager = pod_manager
+        self._worker_liveness = {}
+        self._max_model_version = 0
+
+    # ---- task dispatch -------------------------------------------------
+
+    def get_task(self, req: pb.GetTaskRequest, ctx) -> pb.GetTaskResponse:
+        task_type = req.task_type if req.filter_by_type else None
+        task = self._tm.get(req.worker_id, task_type=task_type)
+        if task is not None:
+            return pb.GetTaskResponse(task=task)
+        if self._tm.finished:
+            return pb.GetTaskResponse(
+                task=pb.Task(task_id=-1, type=pb.WAIT), job_finished=True
+            )
+        return pb.GetTaskResponse(task=pb.Task(task_id=-1, type=pb.WAIT))
+
+    def report_task_result(self, req: pb.ReportTaskResultRequest, ctx):
+        self._tm.report(
+            req.task_id,
+            success=(req.err_message == ""),
+            worker_id=req.worker_id,
+            records=req.exec_counters.get("records", 0),
+        )
+        return pb.Empty()
+
+    # ---- evaluation ----------------------------------------------------
+
+    def report_evaluation_metrics(
+        self, req: pb.ReportEvaluationMetricsRequest, ctx
+    ):
+        if self._eval is not None:
+            self._eval.report_metrics(req)
+        return pb.Empty()
+
+    def report_version(self, req: pb.ReportVersionRequest, ctx):
+        self._max_model_version = max(
+            self._max_model_version, req.model_version
+        )
+        if self._eval is not None:
+            self._eval.on_version_report(req.model_version)
+        return pb.Empty()
+
+    # ---- membership ----------------------------------------------------
+
+    def get_cluster_spec(self, req: pb.GetClusterSpecRequest, ctx):
+        if self._rendezvous is None:
+            return pb.ClusterSpec(rendezvous_id=0, world_size=1)
+        return self._rendezvous.cluster_spec(req)
+
+    def keep_alive(self, req: pb.KeepAliveRequest, ctx):
+        self._worker_liveness[req.worker_id] = time.time()
+        return pb.Empty()
+
+    # ---- introspection -------------------------------------------------
+
+    @property
+    def max_model_version(self) -> int:
+        return self._max_model_version
+
+    def worker_last_seen(self, worker_id: int) -> Optional[float]:
+        return self._worker_liveness.get(worker_id)

@@ -1,0 +1,89 @@
+"""Device-mesh management: the TPU-native replacement for the reference's
+process-level cluster topology (PS shards + Horovod ring — SURVEY.md C15/C16).
+
+All parallelism in elasticdl-tpu is expressed as a `jax.sharding.Mesh` with
+up to four logical axes:
+
+  data     — data parallelism (the reference's only strategy)
+  model    — sharded embedding tables / tensor parallelism
+  seq      — sequence/context parallelism (ring attention)
+  expert   — expert parallelism (MoE)
+
+Elasticity = rebuilding the mesh when membership changes: the rendezvous
+server bumps an epoch, every process re-initialises jax.distributed with the
+new topology, `create_mesh` lays the surviving devices out again, and the
+train step recompiles for the new shapes (state restored from Orbax).  The
+task queue makes this cheap — no step-exact replay, just re-leased shards.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def create_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data: int = -1,
+    model: int = 1,
+    seq: int = 1,
+    expert: int = 1,
+) -> Mesh:
+    """Build a mesh over `devices` (default: all).  `data=-1` absorbs the
+    remaining devices after the explicit axes are carved out."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = model * seq * expert
+    if data == -1:
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by model*seq*expert={fixed}"
+            )
+        data = n // fixed
+    if data * fixed != n:
+        raise ValueError(
+            f"mesh {data}x{model}x{seq}x{expert} != {n} devices"
+        )
+    arr = np.array(devices).reshape(data, model, seq, expert)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch sharding: leading axis split over `data` (replicated over the
+    other mesh axes)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
+    """Place a host batch onto the mesh split along the data axis."""
+    sharding = data_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def pad_to_multiple(batch: Dict[str, np.ndarray], multiple: int):
+    """Pad batch leading dim up to a multiple (wrapping existing rows) so
+    shapes stay static under jit; returns (padded_batch, real_count)."""
+    sizes = {x.shape[0] for x in jax.tree.leaves(batch)}
+    assert len(sizes) == 1, "ragged batch"
+    n = sizes.pop()
+    if n % multiple == 0:
+        return batch, n
+    target = ((n + multiple - 1) // multiple) * multiple
+    reps = (target + n - 1) // n
+
+    def pad(x):
+        return np.concatenate([x] * reps, axis=0)[:target]
+
+    return jax.tree.map(pad, batch), n

@@ -1,0 +1,76 @@
+"""Turns the stream of leased tasks into a stream of fixed-shape batches.
+
+Parity: reference python/worker/task_data_service.py (SURVEY.md C8) — the
+invariant preserved is *task completion ≡ data consumed*: a task is
+reported back to the master only after every batch cut from its records has
+been yielded to the train loop.  Unlike the reference (tf.data generator),
+batches never span task boundaries; the final partial batch of a task is
+padded by wrapping records so shapes stay static under jit (no recompiles),
+with the true record count carried alongside for metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterator, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+
+class TaskDataService:
+    def __init__(self, master_client, data_reader, worker_id: int,
+                 wait_sleep_s: float = 0.5):
+        self._client = master_client
+        self._reader = data_reader
+        self._worker_id = worker_id
+        self._wait_sleep_s = wait_sleep_s
+
+    def get_task(self, task_type=None) -> Tuple[Optional[pb.Task], bool]:
+        """Poll the master for a task.  Returns (task|None, job_finished);
+        blocks through WAIT responses with backoff."""
+        while True:
+            req = pb.GetTaskRequest(worker_id=self._worker_id)
+            if task_type is not None:
+                req.task_type = task_type
+                req.filter_by_type = True
+            resp = self._client.get_task(req)
+            if resp.job_finished:
+                return None, True
+            task = resp.task
+            if task.task_id < 0 or task.type == pb.WAIT:
+                time.sleep(self._wait_sleep_s)
+                continue
+            return task, False
+
+    def report_task(self, task: pb.Task, err: str = "", records: int = 0):
+        req = pb.ReportTaskResultRequest(
+            task_id=task.task_id,
+            err_message=err,
+            worker_id=self._worker_id,
+        )
+        req.exec_counters["records"] = records
+        self._client.report_task_result(req)
+
+    def batches_for_task(
+        self,
+        task: pb.Task,
+        batch_size: int,
+        feed: Callable,
+    ) -> Iterator[Tuple[dict, int]]:
+        """Yield (batch, real_count) for one task.  `feed(records)` maps a
+        list of raw records to a batch dict of arrays (zoo contract).  The
+        final partial batch is wrap-padded to exactly `batch_size`
+        (mesh.pad_to_multiple) so shapes stay static under jit."""
+        from elasticdl_tpu.parallel.mesh import pad_to_multiple
+
+        buf = []
+        for record in self._reader.read_records(task):
+            buf.append(record)
+            if len(buf) == batch_size:
+                yield feed(buf), batch_size
+                buf = []
+        if buf:
+            yield pad_to_multiple(feed(buf), batch_size)

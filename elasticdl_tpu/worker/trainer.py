@@ -1,0 +1,181 @@
+"""The XLA-compiled training engine.
+
+This replaces three reference components at once (SURVEY.md §7 design
+mapping):
+
+- the worker's eager `tf.GradientTape` step (C7),
+- the parameter-server optimizer application, Python and Go/Eigen
+  (C10/C16/C17) — Optax inside the jitted step; XLA *is* the native
+  kernel,
+- Horovod's dense-gradient AllReduce (C15) — gradient reduction over the
+  mesh `data` axis is inserted by XLA from the NamedShardings.
+
+One `jit`-compiled function owns forward + backward + optimizer update;
+params/opt state live replicated (or sharded) on the mesh, the batch is
+split along `data`.  bfloat16 compute keeps the MXU fed; params stay f32.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+logger = get_logger(__name__)
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+class Trainer:
+    """Builds and owns the jitted train/eval steps for one model.
+
+    model_fn: flax Module (or any object with .init/.apply) — the zoo's
+              `custom_model()`
+    loss_fn:  (labels, predictions) -> scalar  — the zoo's `loss`
+    optimizer: optax.GradientTransformation    — the zoo's `optimizer()`
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss_fn: Callable,
+        mesh=None,
+        use_bf16: bool = False,
+        param_sharding_fn: Optional[Callable] = None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+        self.use_bf16 = use_bf16
+        self._param_sharding_fn = param_sharding_fn
+        self._repl = mesh_lib.replicated(self.mesh)
+        self._data = mesh_lib.data_sharding(self.mesh)
+        self._build_steps()
+
+    # ---- state ---------------------------------------------------------
+
+    def init_state(self, rng, sample_features) -> TrainState:
+        params = self.model.init(rng, self._cast(sample_features))
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.optimizer.init(params),
+        )
+        return jax.device_put(state, self.state_sharding(state))
+
+    def state_sharding(self, state):
+        """Sharding tree for the train state: replicated by default;
+        `param_sharding_fn(path, value) -> PartitionSpec` overrides (used
+        by sharded embedding tables / tensor parallelism)."""
+        if self._param_sharding_fn is None:
+            return jax.tree.map(lambda _: self._repl, state)
+
+        def spec_for(path, leaf):
+            spec = self._param_sharding_fn(path, leaf)
+            return NamedSharding(self.mesh, spec if spec is not None else P())
+
+        params_sh = jax.tree_util.tree_map_with_path(spec_for, state.params)
+        # Optax states embed per-param moment trees with the SAME pytree
+        # structure as params (mu/nu in Adam, trace in momentum, ...);
+        # shard those like the params and replicate everything else
+        # (counts, scalars).  Structure matching — not shape matching —
+        # so same-shaped params with different specs stay distinct.
+        param_treedef = jax.tree.structure(state.params)
+
+        def is_param_like(subtree):
+            try:
+                return jax.tree.structure(subtree) == param_treedef
+            except Exception:
+                return False
+
+        def shard_subtree(subtree):
+            if is_param_like(subtree):
+                return params_sh
+            return jax.tree.map(lambda _: self._repl, subtree)
+
+        opt_sh = jax.tree.map(
+            shard_subtree, state.opt_state, is_leaf=is_param_like
+        )
+        return TrainState(step=self._repl, params=params_sh, opt_state=opt_sh)
+
+    def _cast(self, features):
+        if not self.use_bf16:
+            return features
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x,
+            features,
+        )
+
+    # ---- steps ---------------------------------------------------------
+
+    def _build_steps(self):
+        def loss_of(params, features, labels):
+            preds = self.model.apply(params, self._cast(features))
+            return jnp.asarray(
+                self.loss_fn(labels, preds.astype(jnp.float32)), jnp.float32
+            )
+
+        def train_step(state: TrainState, batch):
+            loss, grads = jax.value_and_grad(loss_of)(
+                state.params, batch["features"], batch["labels"]
+            )
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(
+                    step=state.step + 1, params=params, opt_state=opt_state
+                ),
+                loss,
+            )
+
+        def eval_step(state: TrainState, features):
+            preds = self.model.apply(state.params, self._cast(features))
+            return preds.astype(jnp.float32)
+
+        # Shardings: batch split on `data`; XLA inserts the gradient
+        # all-reduce from the sharding propagation (no explicit psum).
+        self.train_step = jax.jit(train_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(eval_step)
+
+    # ---- host-side helpers --------------------------------------------
+
+    def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
+        batch = mesh_lib.shard_batch(batch, self.mesh)
+        state, loss = self.train_step(state, batch)
+        return state, loss
+
+    def predict_on_batch(self, state, features):
+        features = jax.tree.map(
+            lambda x: jax.device_put(x, self._data), features
+        )
+        return np.asarray(self.eval_step(state, features))
+
+    def timed_steps_per_sec(self, state, batch, iters: int = 20):
+        batch = mesh_lib.shard_batch(batch, self.mesh)
+        state, loss = self.train_step(state, batch)  # compile
+        jax.block_until_ready(loss)
+        start = time.perf_counter()
+        for _ in range(iters):
+            state, loss = self.train_step(state, batch)
+        jax.block_until_ready(loss)
+        return iters / (time.perf_counter() - start), state

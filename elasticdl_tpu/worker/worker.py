@@ -1,0 +1,204 @@
+"""Worker runtime: pull tasks, train/evaluate/predict, report.
+
+Parity: reference python/worker/worker.py (SURVEY.md C7, call stack §3.3).
+Differences by design: the hot loop is an XLA-compiled step on the device
+mesh instead of eager ops + per-step PS RPCs — the only RPCs left are
+per-*shard* get_task/report (the property that kept master load low in the
+reference is preserved exactly).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_handler import ModelSpec
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+from elasticdl_tpu.worker.trainer import Trainer
+
+logger = get_logger(__name__)
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_id: int,
+        master_client,
+        data_reader,
+        spec: ModelSpec,
+        minibatch_size: int = 64,
+        mesh=None,
+        use_bf16: bool = False,
+        seed: int = 0,
+        checkpoint_saver=None,
+        checkpoint_steps: int = 0,
+    ):
+        self.worker_id = worker_id
+        self.spec = spec
+        self.minibatch_size = minibatch_size
+        self._client = master_client
+        self._data_service = TaskDataService(
+            master_client, data_reader, worker_id
+        )
+        self.trainer = Trainer(
+            model=spec.model,
+            optimizer=spec.optimizer,
+            loss_fn=spec.loss,
+            mesh=mesh,
+            use_bf16=use_bf16,
+            param_sharding_fn=spec.param_sharding,
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self.state = None
+        self._reader = data_reader
+        self._checkpoint_saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        # Bounded: device arrays, converted lazily; unbounded growth would
+        # pin one device buffer per step for the job's lifetime.
+        from collections import deque
+
+        self.losses = deque(maxlen=1024)
+
+    # ---- init ----------------------------------------------------------
+
+    def _ensure_state(self, batch: Dict[str, np.ndarray]):
+        if self.state is None:
+            self.state = self.trainer.init_state(
+                self._rng, batch["features"]
+            )
+            if self._checkpoint_saver is not None:
+                restored = self._checkpoint_saver.maybe_restore(self.state)
+                if restored is not None:
+                    self.state = restored
+                    logger.info("Restored state from checkpoint")
+
+    # ---- loops ---------------------------------------------------------
+
+    def run(self) -> bool:
+        """Main loop until the master declares the job finished.  Returns
+        True on clean completion."""
+        while True:
+            task, finished = self._data_service.get_task()
+            if finished:
+                logger.info("Job finished; worker %d exiting", self.worker_id)
+                return True
+            try:
+                records = self._process_task(task)
+                self._data_service.report_task(task, records=records)
+                if task.type == pb.TRAINING and self.state is not None:
+                    self._client.report_version(
+                        pb.ReportVersionRequest(
+                            worker_id=self.worker_id,
+                            model_version=int(self.state.step),
+                        )
+                    )
+            except Exception as exc:  # report failure; master re-queues
+                logger.error(
+                    "Task %d failed on worker %d: %s",
+                    task.task_id, self.worker_id, exc,
+                )
+                traceback.print_exc()
+                # An exception with an empty str() must still read as a
+                # failure on the wire (err_message=="" means success).
+                err = str(exc) or type(exc).__name__
+                self._data_service.report_task(task, err=err)
+
+    def _process_task(self, task: pb.Task) -> int:
+        if task.type == pb.TRAINING:
+            return self._train_task(task)
+        if task.type == pb.EVALUATION:
+            return self._evaluate_task(task)
+        if task.type == pb.PREDICTION:
+            return self._predict_task(task)
+        if task.type == pb.SAVE_MODEL:
+            self._save_model(task)
+            return 0
+        logger.warning("Unknown task type %s", task.type)
+        return 0
+
+    def _train_task(self, task: pb.Task) -> int:
+        records = 0
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            self.state, loss = self.trainer.train_on_batch(self.state, batch)
+            records += real
+            self.losses.append(loss)
+            self._maybe_checkpoint()
+        return records
+
+    def _evaluate_task(self, task: pb.Task) -> int:
+        """Forward-only over the shard; metrics computed host-side on the
+        un-padded slice and reported to the master for aggregation."""
+        if self.state is None and self._checkpoint_saver is None:
+            # A fresh worker (e.g. a replacement pod) must not report
+            # metrics from randomly initialised params.  Re-queue the task
+            # for a worker with trained state (or let checkpoint restore
+            # below provide one).
+            raise RuntimeError(
+                "worker has no trained state for evaluation; re-queueing"
+            )
+        records = 0
+        sums: Dict[str, float] = {}
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            preds = self.trainer.predict_on_batch(
+                self.state, batch["features"]
+            )
+            labels = np.asarray(batch["labels"])[:real]
+            preds = preds[:real]
+            for name, fn in self.spec.eval_metrics.items():
+                sums[name] = sums.get(name, 0.0) + float(
+                    fn(labels, preds)
+                ) * real
+            records += real
+        if records:
+            req = pb.ReportEvaluationMetricsRequest(
+                worker_id=self.worker_id,
+                model_version=task.model_version
+                if task.model_version >= 0
+                else int(self.state.step) if self.state is not None else 0,
+                num_examples=records,
+            )
+            for name, total in sums.items():
+                req.metrics[name] = total / records
+            self._client.report_evaluation_metrics(req)
+        return records
+
+    def _predict_task(self, task: pb.Task) -> int:
+        records = 0
+        self.predictions = getattr(self, "predictions", [])
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            preds = self.trainer.predict_on_batch(
+                self.state, batch["features"]
+            )
+            self.predictions.append(preds[:real])
+            records += real
+        return records
+
+    def _save_model(self, task: pb.Task):
+        if self._checkpoint_saver is not None and self.state is not None:
+            self._checkpoint_saver.save(self.state, force=True)
+
+    def _maybe_checkpoint(self):
+        if (
+            self._checkpoint_saver is not None
+            and self._checkpoint_steps
+            and self.state is not None
+            and int(self.state.step) % self._checkpoint_steps == 0
+        ):
+            self._checkpoint_saver.save(self.state)
+
+    def _feed(self, records):
+        return self.spec.feed(records, getattr(self._reader, "metadata", {}))

@@ -1,0 +1,69 @@
+"""MNIST CNN — zoo-contract port of the reference's
+model_zoo/mnist/mnist_functional_api.py (SURVEY.md C20) re-implemented as a
+Flax module (the contract function names are unchanged).
+
+Records are either dicts {"image": (784,) float/uint8, "label": int} (memory
+reader) or 785-byte blobs (784 image bytes + 1 label byte) from TFRecord
+files written by model_zoo.mnist.data.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class MnistCNN(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], 28, 28, 1)
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)  # logits
+
+
+def custom_model():
+    return MnistCNN()
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 1e-3):
+    return optax.adam(lr)
+
+
+def feed(records, metadata=None):
+    images, labels = [], []
+    for record in records:
+        if isinstance(record, dict):
+            images.append(np.asarray(record["image"], np.float32))
+            labels.append(int(record["label"]))
+        else:
+            arr = np.frombuffer(record, dtype=np.uint8)
+            images.append(arr[:784].astype(np.float32))
+            labels.append(int(arr[784]))
+    features = np.stack(images) / 255.0
+    return {
+        "features": features.astype(np.float32),
+        "labels": np.asarray(labels, np.int32),
+    }
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: float(
+            np.mean(np.argmax(predictions, axis=-1) == labels)
+        ),
+    }
