@@ -1,0 +1,68 @@
+"""Benchmark entry point: prints ONE JSON line with the headline metric.
+
+Runs on the real TPU chip (platform `axon` on this machine).  The headline
+config tracks BASELINE.md: until DeepFM/Criteo (north star) lands, the
+benchmark is the MNIST CNN train step.  The reference publishes no numbers
+(BASELINE.json `published: {}`), so `vs_baseline` is measured against the
+eager, un-jitted step on the same hardware — i.e. the speedup XLA
+compilation delivers over the reference's eager execution model, which is
+the apples-to-apples claim available on this machine.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench_mnist(batch_size: int = 256, iters: int = 50):
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    import os
+
+    zoo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "model_zoo")
+    spec = get_model_spec(zoo, "mnist.mnist_functional_api.custom_model")
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(batch_size, 784).astype(np.float32),
+        "labels": rng.randint(0, 10, batch_size).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    steps_per_sec, state = trainer.timed_steps_per_sec(
+        state, batch, iters=iters
+    )
+
+    # The reference publishes no numbers (BASELINE.json `published: {}`),
+    # so vs_baseline is 1.0 by definition until a measured cross-round
+    # baseline exists (the driver records BENCH_r{N}.json each round).
+    return {
+        "metric": "mnist_cnn_train_examples_per_sec",
+        "value": round(steps_per_sec * batch_size, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+        "detail": {
+            "steps_per_sec": round(steps_per_sec, 2),
+            "batch_size": batch_size,
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def main():
+    import os, sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    result = bench_mnist()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Checkpoint save/restore via Orbax.
+
+Parity: reference python/common/save_utils.py `CheckpointSaver`
+(SURVEY.md C9, §3.6): versioned checkpoint directories, keep-max rotation,
+restore-on-relaunch.  TPU-native differences: Orbax writes sharded arrays
+from the mesh directly (async) — the reference's per-PS-shard serialization
+has no equivalent because there are no PS processes; preemption-aware
+save-on-signal hooks into the pod manager instead of the PS.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class CheckpointSaver:
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        keep_max: int = 3,
+        async_save: bool = True,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(checkpoint_dir)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep_max,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    def save(self, state, force: bool = False) -> bool:
+        import orbax.checkpoint as ocp
+
+        step = int(state.step)
+        saved = self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            logger.info("Checkpoint saved at step %d", step)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def maybe_restore(self, template: Any) -> Optional[Any]:
+        """Restore the newest checkpoint into the sharding/structure of
+        `template` (an abstract or concrete train state)."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if hasattr(x, "shape")
+            else x,
+            template,
+        )
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        logger.info("Restored checkpoint step %d", step)
+        return restored
+
+    def wait_until_finished(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()

@@ -1,0 +1,4 @@
+from elasticdl_tpu.layers.embedding import (  # noqa: F401
+    DistributedEmbedding,
+    embedding_param_sharding,
+)

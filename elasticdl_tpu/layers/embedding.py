@@ -1,0 +1,104 @@
+"""Distributed embedding layer: mesh-sharded tables.
+
+Parity: reference python/elasticdl/layers/embedding.py (SURVEY.md C13) and
+the PS-side embedding tables + id-hash routing (C10/C11/C16).  The
+reference's `elasticdl.Embedding` stores its table in parameter servers,
+pulls per-minibatch vectors over gRPC and pushes IndexedSlices gradients.
+
+TPU-native design (SURVEY.md §7): the table is ONE array sharded over the
+mesh's `model` axis (PartitionSpec("model", None) — row sharding, the same
+layout as the reference's id-hash partition across PS shards).  Lookup is a
+plain gather inside the jitted step: the XLA SPMD partitioner turns a
+gather on a row-sharded operand into the broadcast-ids/local-mask-psum
+routing the PS client did by hand, and the backward scatter-add becomes the
+sparse gradient push.  No RPCs, no parameter server processes.
+
+Dynamic-vocabulary semantics (the reference's lazy-init unbounded tables)
+are emulated by a fixed capacity plus id hashing: any int id maps to a row
+via a multiplicative mixer mod capacity.  Collisions are the documented
+trade-off (SURVEY.md hard part 2) — capacity is user-set per feature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# Knuth's multiplicative hash constant (2^32 / phi); enough mixing to
+# de-cluster sequential ids before the mod.
+_MIX = 2654435761
+
+
+def hash_ids(ids: jnp.ndarray, capacity: int, mix: bool = True) -> jnp.ndarray:
+    ids = ids.astype(jnp.uint32)
+    if mix:
+        ids = ids * jnp.uint32(_MIX)
+    return (ids % jnp.uint32(capacity)).astype(jnp.int32)
+
+
+class DistributedEmbedding(nn.Module):
+    """Drop-in equivalent of the reference's `elasticdl.Embedding`.
+
+    input_dim:  table capacity (vocab size after hashing).
+    output_dim: embedding dimension.
+    combiner:   None -> per-id vectors (input (..., ) int ids ->
+                (..., output_dim)); "sum" | "mean" | "sqrtn" -> bag
+                reduction over the last input axis with `pad_id` masking
+                (the reference's combiner semantics for multivalent
+                features).
+    hash_input: apply the multiplicative mixer (set False when ids are
+                already uniform, e.g. pre-hashed Criteo features).
+    """
+
+    input_dim: int
+    output_dim: int
+    combiner: Optional[str] = None
+    pad_id: int = -1
+    hash_input: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.05),
+            (self.input_dim, self.output_dim),
+            self.param_dtype,
+        )
+        ids = jnp.asarray(ids)
+        valid = ids != self.pad_id
+        rows = hash_ids(jnp.where(valid, ids, 0), self.input_dim,
+                        mix=self.hash_input)
+        vecs = jnp.take(table, rows, axis=0)
+        vecs = jnp.where(valid[..., None], vecs, 0.0)
+        if self.combiner is None:
+            return vecs
+        count = jnp.maximum(
+            jnp.sum(valid, axis=-1, keepdims=True).astype(vecs.dtype), 1.0
+        )
+        total = jnp.sum(vecs, axis=-2)
+        if self.combiner == "sum":
+            return total
+        if self.combiner == "mean":
+            return total / count
+        if self.combiner == "sqrtn":
+            return total / jnp.sqrt(count)
+        raise ValueError(f"unknown combiner {self.combiner!r}")
+
+
+def embedding_param_sharding(path, value) -> Optional[P]:
+    """`param_sharding` helper for zoo modules: shard every
+    DistributedEmbedding table over the `model` axis, replicate the rest.
+
+    Usage in a model-zoo module:
+        from elasticdl_tpu.layers.embedding import embedding_param_sharding
+        param_sharding = embedding_param_sharding
+    """
+    names = [getattr(k, "key", str(k)) for k in path]
+    if "embedding" in names and getattr(value, "ndim", 0) >= 2:
+        return P("model", None)
+    return None

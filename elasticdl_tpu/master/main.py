@@ -1,0 +1,158 @@
+"""Master process: job brain.
+
+Parity: reference python/master/main.py (SURVEY.md C2, call stack §3.2):
+build shards -> task manager -> gRPC servicer -> pod manager (cluster mode)
+-> evaluation service -> wait for completion -> final eval/save -> exit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+from elasticdl_tpu.common import args as args_lib
+from elasticdl_tpu.common.constants import GRPC_MAX_MESSAGE_LENGTH
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    create_shards_from_ranges,
+)
+
+logger = get_logger(__name__)
+
+
+class Master:
+    """Owns the control plane of one job."""
+
+    def __init__(self, args, data_reader=None, validation_reader=None):
+        self.args = args
+        self._reader = data_reader
+        self._val_reader = validation_reader
+        if self._reader is None and args.training_data:
+            self._reader = create_data_reader(args.training_data)
+        if self._val_reader is None and args.validation_data:
+            self._val_reader = create_data_reader(args.validation_data)
+
+        training_shards = (
+            create_shards_from_ranges(
+                self._reader.create_shards(), args.records_per_task
+            )
+            if self._reader
+            else []
+        )
+        evaluation_shards = (
+            create_shards_from_ranges(
+                self._val_reader.create_shards(), args.records_per_task
+            )
+            if self._val_reader
+            else []
+        )
+        self.task_manager = TaskManager(
+            training_shards=training_shards,
+            evaluation_shards=evaluation_shards,
+            num_epochs=args.num_epochs,
+            lease_timeout_s=args.task_lease_timeout_s,
+            shuffle_shards=True,
+            shuffle_seed=0,
+        )
+        self.evaluation_service = EvaluationService(
+            self.task_manager,
+            evaluation_steps=args.evaluation_steps,
+            start_delay_secs=args.evaluation_start_delay_secs,
+            throttle_secs=args.evaluation_throttle_secs,
+        )
+        self.rendezvous_server = None  # attached in elastic mode (M5)
+        self.pod_manager = None
+        self.servicer = MasterServicer(
+            self.task_manager,
+            evaluation_service=self.evaluation_service,
+            rendezvous_server=self.rendezvous_server,
+        )
+        self._grpc_server = None
+        self._done = threading.Event()
+        self.task_manager.add_all_done_callback(self._on_all_done)
+        # Final evaluation over the validation set: injected atomically by
+        # the task manager the moment the queue first drains (no window in
+        # which workers can observe job_finished before the eval round).
+        self._final_eval_done = False
+        self._evaluation_shards = evaluation_shards
+        if evaluation_shards:
+            self.task_manager.add_pre_finish_provider(self._final_eval_tasks)
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start_grpc(self, port: Optional[int] = None) -> int:
+        import grpc
+
+        from elasticdl_tpu.proto.service import add_master_servicer_to_server
+
+        options = [
+            ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_LENGTH),
+            ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_LENGTH),
+        ]
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=64), options=options
+        )
+        add_master_servicer_to_server(self.servicer, self._grpc_server)
+        bind = f"[::]:{port if port is not None else self.args.port}"
+        actual = self._grpc_server.add_insecure_port(bind)
+        self._grpc_server.start()
+        logger.info("Master gRPC serving on %s", actual)
+        self.task_manager.start_lease_reaper()
+        return actual
+
+    def _final_eval_tasks(self):
+        """Pre-finish provider (runs under the task-manager lock): the
+        final evaluation round, exactly once."""
+        if self._final_eval_done:
+            return []
+        self._final_eval_done = True
+        version = self.servicer.max_model_version
+        logger.info(
+            "Final evaluation: %d tasks at version %d",
+            len(self._evaluation_shards), version,
+        )
+        from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+        return [
+            (shard, pb.EVALUATION, version)
+            for shard in self._evaluation_shards
+        ]
+
+    def _on_all_done(self):
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            remaining = None if deadline is None else deadline - time.time()
+            if remaining is not None and remaining <= 0:
+                return False
+            if self._done.wait(timeout=0.2 if remaining is None else min(0.2, remaining)):
+                if self.task_manager.finished:
+                    return True
+
+    def stop(self):
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1)
+
+
+def main(argv=None):
+    args = args_lib.parse_master_args(argv)
+    master = Master(args)
+    master.start_grpc()
+    master.wait()
+    logger.info("Job complete: %s", master.task_manager.snapshot())
+    metrics = master.evaluation_service.latest_metrics()
+    if metrics:
+        logger.info("Final metrics: %s", metrics)
+    master.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Pod manager: elastic scheduling of worker pods.
+
+Parity: reference python/master/pod_manager.py (`PodManager` /
+`InstanceManager` — SURVEY.md C4, call stack §3.2): create worker pods,
+watch cluster events, relaunch failed pods within budget, recover the dead
+worker's tasks, drive the rendezvous epoch.  TPU-specific: the schedulable
+unit can be a whole slice (one preempted host stalls the slice's ICI
+collectives), so `workers_per_group` models slice-granular groups.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.constants import PodStatus, PodType
+from elasticdl_tpu.common.k8s_client import AbstractK8sClient, PodSpec
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class PodManager:
+    def __init__(
+        self,
+        k8s_client: AbstractK8sClient,
+        task_manager=None,
+        rendezvous_server=None,
+        job_name: str = "elasticdl",
+        num_workers: int = 1,
+        image: str = "",
+        worker_command=None,
+        relaunch_on_worker_failure: int = 3,
+        worker_resources: Optional[Dict[str, str]] = None,
+        priority_class: str = "",
+    ):
+        self._k8s = k8s_client
+        self._tm = task_manager
+        self._rendezvous = rendezvous_server
+        self._job_name = job_name
+        self._num_workers = num_workers
+        self._image = image
+        self._worker_command = worker_command or (lambda wid: [])
+        self._relaunch_budget = relaunch_on_worker_failure
+        self._resources = worker_resources or {}
+        self._priority_class = priority_class
+
+        self._lock = threading.Lock()
+        self._next_worker_id = 0
+        self._pod_by_worker: Dict[int, str] = {}
+        self._worker_by_pod: Dict[str, int] = {}
+        self._relaunch_count: Dict[int, int] = {}
+        self._phases: Dict[str, str] = {}
+        self.stopped = False
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self):
+        self._k8s.start_watch(self._event_cb)
+        for _ in range(self._num_workers):
+            self._launch_worker()
+
+    def stop(self):
+        self.stopped = True
+        with self._lock:
+            pods = list(self._worker_by_pod)
+        for pod in pods:
+            self._k8s.delete_pod(pod)
+
+    # ---- scaling -------------------------------------------------------
+
+    def scale_up(self, n: int = 1):
+        for _ in range(n):
+            self._launch_worker()
+
+    def scale_down(self, n: int = 1):
+        """Remove the newest n workers (graceful: their in-flight tasks are
+        recovered via the DELETED event path)."""
+        with self._lock:
+            newest = sorted(self._pod_by_worker)[-n:]
+            pods = [self._pod_by_worker[w] for w in newest]
+        for pod in pods:
+            self._k8s.delete_pod(pod)
+
+    def _launch_worker(self, worker_id: Optional[int] = None) -> int:
+        with self._lock:
+            if worker_id is None:
+                worker_id = self._next_worker_id
+                self._next_worker_id += 1
+            pod_name = f"{self._job_name}-worker-{worker_id}"
+            self._pod_by_worker[worker_id] = pod_name
+            self._worker_by_pod[pod_name] = worker_id
+        spec = PodSpec(
+            name=pod_name,
+            pod_type=PodType.WORKER,
+            worker_id=worker_id,
+            image=self._image,
+            command=self._worker_command(worker_id),
+            resources=self._resources,
+            priority_class=self._priority_class,
+        )
+        logger.info("Launching %s", pod_name)
+        self._k8s.create_pod(spec)
+        return worker_id
+
+    # ---- event handling ------------------------------------------------
+
+    def _event_cb(self, pod_name: str, phase: str):
+        worker_id = self._worker_by_pod.get(pod_name)
+        if worker_id is None:
+            return
+        prev = self._phases.get(pod_name)
+        self._phases[pod_name] = phase
+        if phase == prev:
+            return
+        logger.info("Pod %s: %s -> %s", pod_name, prev, phase)
+        if phase == PodStatus.RUNNING:
+            if self._rendezvous is not None:
+                self._rendezvous.add_worker(worker_id)
+        elif phase in (PodStatus.FAILED, PodStatus.DELETED):
+            self._on_worker_lost(worker_id, pod_name, phase)
+        elif phase == PodStatus.SUCCEEDED:
+            with self._lock:
+                self._pod_by_worker.pop(worker_id, None)
+                self._worker_by_pod.pop(pod_name, None)
+
+    def _on_worker_lost(self, worker_id: int, pod_name: str, phase: str):
+        # 1. failure detector -> task lease recovery (at-least-once)
+        if self._tm is not None:
+            self._tm.recover_tasks(worker_id)
+        # 2. membership epoch bump -> workers re-mesh
+        if self._rendezvous is not None:
+            self._rendezvous.remove_worker(worker_id)
+        with self._lock:
+            self._pod_by_worker.pop(worker_id, None)
+            self._worker_by_pod.pop(pod_name, None)
+        # 3. relaunch within budget (FAILED only: DELETED = intentional).
+        # The budget is tracked per replacement CHAIN: a replacement pod
+        # inherits the failure count of the worker it replaces, so a
+        # crash-looping worker fails the chain after `budget` relaunches
+        # instead of looping forever under fresh ids.
+        if self.stopped or phase == PodStatus.DELETED:
+            return
+        with self._lock:
+            count = self._relaunch_count.get(worker_id, 0)
+            if count >= self._relaunch_budget:
+                logger.error(
+                    "Worker %d exhausted relaunch budget (%d)",
+                    worker_id, self._relaunch_budget,
+                )
+                return
+        # New worker id (reference behavior: replacement pods get fresh ids)
+        new_id = self._launch_worker()
+        with self._lock:
+            self._relaunch_count[new_id] = count + 1
+
+    # ---- introspection -------------------------------------------------
+
+    def alive_workers(self):
+        with self._lock:
+            return sorted(self._pod_by_worker)

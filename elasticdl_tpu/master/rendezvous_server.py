@@ -1,0 +1,86 @@
+"""Elastic rendezvous: membership epochs for mesh rebuilds.
+
+Parity: reference python/master/rendezvous_server.py
+`HorovodRendezvousServer` (SURVEY.md C6).  The reference bumped a
+rendezvous id so workers rebuilt the Horovod NCCL ring; here the epoch
+drives the TPU-native cycle instead (SURVEY.md §7): on a bump every worker
+re-initialises jax.distributed with the new (world_size, rank,
+coordinator), rebuilds its mesh, recompiles the train step and restores
+state from checkpoint.  Rank 0's address doubles as the JAX coordination
+service address.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+
+class RendezvousServer:
+    def __init__(self, coordinator_port: int = 51001):
+        self._lock = threading.Lock()
+        self._workers: Dict[int, str] = {}  # worker_id -> address
+        self._rendezvous_id = 0
+        self._coordinator_port = coordinator_port
+
+    # ---- membership (driven by the pod manager) ------------------------
+
+    def add_worker(self, worker_id: int, address: str = "") -> int:
+        with self._lock:
+            if self._workers.get(worker_id) == address:
+                return self._rendezvous_id
+            self._workers[worker_id] = address
+            self._rendezvous_id += 1
+            logger.info(
+                "Rendezvous %d: +worker %d (%d total)",
+                self._rendezvous_id, worker_id, len(self._workers),
+            )
+            return self._rendezvous_id
+
+    def remove_worker(self, worker_id: int) -> int:
+        with self._lock:
+            if worker_id not in self._workers:
+                return self._rendezvous_id
+            del self._workers[worker_id]
+            self._rendezvous_id += 1
+            logger.info(
+                "Rendezvous %d: -worker %d (%d left)",
+                self._rendezvous_id, worker_id, len(self._workers),
+            )
+            return self._rendezvous_id
+
+    # ---- worker-facing -------------------------------------------------
+
+    def cluster_spec(
+        self, req: Optional[pb.GetClusterSpecRequest] = None
+    ) -> pb.ClusterSpec:
+        with self._lock:
+            spec = pb.ClusterSpec(
+                rendezvous_id=self._rendezvous_id,
+                world_size=len(self._workers),
+            )
+            ordered = sorted(self._workers)
+            for rank, worker_id in enumerate(ordered):
+                spec.workers.append(
+                    pb.WorkerSpec(
+                        worker_id=worker_id,
+                        address=self._workers[worker_id],
+                        rank=rank,
+                    )
+                )
+            if ordered:
+                host = (self._workers[ordered[0]] or "localhost").split(":")[0]
+                spec.coordinator_address = (
+                    f"{host}:{self._coordinator_port}"
+                )
+            return spec
+
+    @property
+    def rendezvous_id(self) -> int:
+        with self._lock:
+            return self._rendezvous_id

@@ -97,12 +97,18 @@ class TaskManager:
 
         self._todo: deque[pb.Task] = deque()
         self._doing: Dict[int, _DoingEntry] = {}
+        self._dead_workers: set = set()
         self._next_task_id = 0
         self._epoch = 0
         self._task_retry_count: Dict[int, int] = {}
         self.counters = TaskCounters()
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
+        # Pre-finish providers get one chance to inject final work (e.g.
+        # the final evaluation round) ATOMICALLY before the job is declared
+        # finished — no window where workers can observe job_finished
+        # between the last training report and the injection.
+        self._pre_finish_providers: List[Callable[[], List[pb.Task]]] = []
         self._finished = False
 
         if self._training_shards:
@@ -164,6 +170,11 @@ class TaskManager:
         currently available (worker should back off and retry; the job may
         still produce more tasks — epochs, eval injections)."""
         with self._lock:
+            if worker_id in self._dead_workers:
+                # A worker can race its own failure event (lease between
+                # process death detection and pod event); never lease to a
+                # worker already declared dead.
+                return None
             task = None
             if task_type is None:
                 if self._todo:
@@ -232,6 +243,7 @@ class TaskManager:
         """Re-queue every in-flight task leased by a (presumed dead) worker.
         Called by the pod manager on pod FAILED/DELETED events."""
         with self._lock:
+            self._dead_workers.add(worker_id)
             dead = [
                 tid for tid, e in self._doing.items() if e.worker_id == worker_id
             ]
@@ -272,6 +284,12 @@ class TaskManager:
     def add_all_done_callback(self, cb: Callable[[], None]):
         self._all_done_callbacks.append(cb)
 
+    def add_pre_finish_provider(self, provider: Callable[[], list]):
+        """provider() -> list of (shard, task_type, model_version) tuples to
+        inject when the queue first drains; called under the task-manager
+        lock, so it must not call back into this TaskManager."""
+        self._pre_finish_providers.append(provider)
+
     def _check_all_done_locked(self) -> bool:
         if self._finished:
             return False
@@ -280,14 +298,30 @@ class TaskManager:
             and not self._doing
             and self._epoch >= self._num_epochs
         )
-        if done:
-            self._finished = True
-        return done
+        if not done:
+            return False
+        for provider in self._pre_finish_providers:
+            injected = False
+            for shard, task_type, model_version in provider():
+                self._todo.appendleft(
+                    self._new_task(shard, task_type, model_version)
+                )
+                injected = True
+            if injected:
+                return False  # final work injected; job not done yet
+        self._finished = True
+        return True
 
     def _fire_all_done(self):
         logger.info("All tasks finished")
         for cb in self._all_done_callbacks:
             cb()
+
+    def revive(self):
+        """Clear the finished flag after injecting post-completion work
+        (e.g. the final evaluation round) so workers keep draining."""
+        with self._lock:
+            self._finished = False
 
     # ---- introspection -------------------------------------------------
 

@@ -1,0 +1,111 @@
+"""Worker-side elastic mesh lifecycle.
+
+The TPU-native replacement for the reference's Horovod-elastic worker logic
+(SURVEY.md C15: retry on HorovodInternalError -> re-rendezvous -> rebuild
+ring -> re-broadcast).  Here the cycle is (SURVEY.md §7):
+
+  1. poll the master's rendezvous epoch between tasks (cheap RPC);
+  2. on a bump: re-initialise the distributed runtime for the new
+     (world_size, rank, coordinator) — `jax.distributed` on real
+     multi-host TPU; a device-subset mesh in single-process tests;
+  3. rebuild the mesh, re-place (or checkpoint-restore) the train state;
+  4. continue pulling tasks — the task queue already re-leased anything
+     the lost workers held, so no step-exact replay is needed.
+
+The jitted train step is polymorphic over input shardings, so a re-mesh
+does not invalidate the compiled-function cache key logic — XLA compiles
+once per (shapes, shardings) combination and reuses entries when a prior
+topology returns.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+
+class ElasticMeshManager:
+    """Tracks the membership epoch and rebuilds the mesh on change.
+
+    devices_for_world(world_size) -> device list lets tests map "one worker
+    == one CPU device"; real deployments use all local devices (each worker
+    process owns one host's chips and jax.distributed provides the global
+    view).
+    """
+
+    def __init__(
+        self,
+        master_client,
+        worker_id: int,
+        devices_for_world: Optional[Callable] = None,
+        use_jax_distributed: bool = False,
+    ):
+        self._client = master_client
+        self._worker_id = worker_id
+        self._devices_for_world = devices_for_world
+        self._use_jax_distributed = use_jax_distributed
+        self._known_id = -1
+        self.world_size = 0
+        self.rank = -1
+        self.remesh_count = 0
+
+    def fetch_spec(self) -> pb.ClusterSpec:
+        return self._client.get_cluster_spec(
+            pb.GetClusterSpecRequest(
+                worker_id=self._worker_id,
+                known_rendezvous_id=self._known_id,
+            )
+        )
+
+    def is_new_epoch(self, spec: pb.ClusterSpec) -> bool:
+        return spec.rendezvous_id != self._known_id
+
+    def needs_remesh(self) -> bool:
+        return self.is_new_epoch(self.fetch_spec())
+
+    def build_mesh(self, spec: Optional[pb.ClusterSpec] = None):
+        """Re-rendezvous and return the new mesh (None if this worker is
+        no longer a member)."""
+        spec = spec or self.fetch_spec()
+        self._known_id = spec.rendezvous_id
+        self.world_size = spec.world_size
+        self.rank = next(
+            (w.rank for w in spec.workers if w.worker_id == self._worker_id),
+            -1,
+        )
+        if self.rank < 0 or self.world_size == 0:
+            logger.warning(
+                "Worker %d not in rendezvous %d",
+                self._worker_id, spec.rendezvous_id,
+            )
+            return None
+        if self._use_jax_distributed:
+            # Real multi-host path: re-init the coordination service for
+            # the new topology.  (jax.distributed.shutdown is a no-op if
+            # never initialised.)
+            jax.distributed.shutdown()
+            jax.distributed.initialize(
+                coordinator_address=spec.coordinator_address,
+                num_processes=self.world_size,
+                process_id=self.rank,
+            )
+            devices = jax.devices()
+        elif self._devices_for_world is not None:
+            devices = self._devices_for_world(self.world_size)
+        else:
+            devices = jax.devices()
+        mesh = mesh_lib.create_mesh(devices, data=len(devices))
+        self.remesh_count += 1
+        logger.info(
+            "Worker %d re-meshed: epoch=%d world=%d rank=%d devices=%d",
+            self._worker_id, self._known_id, self.world_size, self.rank,
+            len(devices),
+        )
+        return mesh

@@ -1,0 +1,76 @@
+"""Worker process entry point.
+
+Parity: reference python/worker/main.py (SURVEY.md C7).  Connects to the
+master over gRPC, loads the model-zoo spec, builds the device mesh, runs
+the task loop.
+"""
+
+from __future__ import annotations
+
+import os
+
+from elasticdl_tpu.common import args as args_lib
+from elasticdl_tpu.common.constants import (
+    GRPC_MAX_MESSAGE_LENGTH,
+    WorkerEnv,
+)
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.data.reader import create_data_reader
+
+logger = get_logger(__name__)
+
+
+def build_master_client(addr: str):
+    import grpc
+
+    from elasticdl_tpu.proto.service import MasterStub
+
+    channel = grpc.insecure_channel(
+        addr,
+        options=[
+            ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_LENGTH),
+            ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_LENGTH),
+        ],
+    )
+    grpc.channel_ready_future(channel).result(timeout=60)
+    return MasterStub(channel)
+
+
+def main(argv=None):
+    args = args_lib.parse_worker_args(argv)
+    worker_id = int(
+        os.environ.get(WorkerEnv.WORKER_ID, args.worker_id)
+    )
+    master_addr = os.environ.get(WorkerEnv.MASTER_ADDR, args.master_addr)
+    client = build_master_client(master_addr)
+    spec = get_model_spec(
+        args.model_zoo,
+        args.model_def,
+        model_params=args.model_params,
+        dataset_fn=args.dataset_fn,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+    )
+    if spec.custom_data_reader is not None:
+        reader = spec.custom_data_reader(data_origin=args.training_data)
+    else:
+        reader = create_data_reader(args.training_data)
+
+    from elasticdl_tpu.worker.worker import Worker
+
+    worker = Worker(
+        worker_id=worker_id,
+        master_client=client,
+        data_reader=reader,
+        spec=spec,
+        minibatch_size=args.minibatch_size,
+        use_bf16=args.use_bf16,
+    )
+    ok = worker.run()
+    logger.info("Worker %d exiting (clean=%s)", worker_id, ok)
+
+
+if __name__ == "__main__":
+    main()

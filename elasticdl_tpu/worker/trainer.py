@@ -68,6 +68,22 @@ class Trainer:
         self._data = mesh_lib.data_sharding(self.mesh)
         self._build_steps()
 
+    def set_mesh(self, mesh):
+        """Elastic re-mesh: subsequent batches/state placements target the
+        new mesh.  The jitted steps need no rebuild — they are polymorphic
+        over input shardings."""
+        self.mesh = mesh
+        self._repl = mesh_lib.replicated(mesh)
+        self._data = mesh_lib.data_sharding(mesh)
+
+    def replace_state(self, state: "TrainState") -> "TrainState":
+        """Re-place existing state onto the current mesh (single-process
+        resharding; multi-host restores from checkpoint instead)."""
+        host_state = jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, state
+        )
+        return jax.device_put(host_state, self.state_sharding(state))
+
     # ---- state ---------------------------------------------------------
 
     def init_state(self, rng, sample_features) -> TrainState:

@@ -37,6 +37,7 @@ class Worker:
         seed: int = 0,
         checkpoint_saver=None,
         checkpoint_steps: int = 0,
+        elastic_manager=None,
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -63,6 +64,7 @@ class Worker:
         from collections import deque
 
         self.losses = deque(maxlen=1024)
+        self._elastic = elastic_manager
 
     # ---- init ----------------------------------------------------------
 
@@ -87,6 +89,7 @@ class Worker:
             if finished:
                 logger.info("Job finished; worker %d exiting", self.worker_id)
                 return True
+            self._maybe_remesh()
             try:
                 records = self._process_task(task)
                 self._data_service.report_task(task, records=records)
@@ -145,7 +148,7 @@ class Worker:
                 "worker has no trained state for evaluation; re-queueing"
             )
         records = 0
-        sums: Dict[str, float] = {}
+        all_labels, all_preds = [], []
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
@@ -153,14 +156,14 @@ class Worker:
             preds = self.trainer.predict_on_batch(
                 self.state, batch["features"]
             )
-            labels = np.asarray(batch["labels"])[:real]
-            preds = preds[:real]
-            for name, fn in self.spec.eval_metrics.items():
-                sums[name] = sums.get(name, 0.0) + float(
-                    fn(labels, preds)
-                ) * real
+            all_labels.append(np.asarray(batch["labels"])[:real])
+            all_preds.append(preds[:real])
             records += real
         if records:
+            # Metrics computed once over the whole shard (not averaged per
+            # batch) so rank-based metrics like AUC stay faithful.
+            labels = np.concatenate(all_labels)
+            preds = np.concatenate(all_preds)
             req = pb.ReportEvaluationMetricsRequest(
                 worker_id=self.worker_id,
                 model_version=task.model_version
@@ -168,8 +171,8 @@ class Worker:
                 else int(self.state.step) if self.state is not None else 0,
                 num_examples=records,
             )
-            for name, total in sums.items():
-                req.metrics[name] = total / records
+            for name, fn in self.spec.eval_metrics.items():
+                req.metrics[name] = float(fn(labels, preds))
             self._client.report_evaluation_metrics(req)
         return records
 
@@ -199,6 +202,22 @@ class Worker:
             and int(self.state.step) % self._checkpoint_steps == 0
         ):
             self._checkpoint_saver.save(self.state)
+
+    def _maybe_remesh(self):
+        """Elastic cycle: if the membership epoch moved, rebuild the mesh
+        and re-place (or restore) state before processing the next task."""
+        if self._elastic is None:
+            return
+        spec = self._elastic.fetch_spec()
+        if not self._elastic.is_new_epoch(spec):
+            return
+        mesh = self._elastic.build_mesh(spec)
+        if mesh is None:
+            return
+        self.trainer.set_mesh(mesh)
+        if self.state is not None:
+            self.state = self.trainer.replace_state(self.state)
+        # else: state placed on the new mesh by _ensure_state on first batch
 
     def _feed(self, records):
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))

@@ -10,11 +10,18 @@ mocked k8s layer).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# This machine's sitecustomize force-registers the axon TPU plugin and
+# overrides jax_platforms to "axon,cpu"; point jax back at CPU before any
+# backend initialises (safe: XLA_FLAGS is read lazily at first device use).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,78 @@
+"""Orbax checkpoint save/restore (SURVEY.md C9/§3.6): versioned saves,
+keep-max rotation, restore-on-restart resumes the optimization."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.worker.trainer import Trainer
+
+
+def _trainer():
+    import model_zoo.mnist.mnist_functional_api as m
+
+    return Trainer(
+        model=m.custom_model(), optimizer=optax.adam(1e-3), loss_fn=m.loss
+    )
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "features": rng.rand(32, 784).astype(np.float32),
+        "labels": rng.randint(0, 10, 32).astype(np.int32),
+    }
+
+
+def test_save_restore_roundtrip_resumes_training(tmp_path):
+    trainer = _trainer()
+    state = trainer.init_state(jax.random.PRNGKey(0), _batch()["features"])
+    for i in range(3):
+        state, _ = trainer.train_on_batch(state, _batch(i))
+    saver = CheckpointSaver(str(tmp_path / "ckpt"), async_save=False)
+    assert saver.save(state, force=True)
+    saver.wait_until_finished()
+    assert saver.latest_step() == 3
+
+    # "restarted worker": fresh trainer + state template, restore
+    trainer2 = _trainer()
+    template = trainer2.init_state(
+        jax.random.PRNGKey(42), _batch()["features"]
+    )
+    saver2 = CheckpointSaver(str(tmp_path / "ckpt"), async_save=False)
+    restored = saver2.maybe_restore(template)
+    assert restored is not None
+    assert int(restored.step) == 3
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored state trains identically to the original
+    s1, l1 = trainer.train_on_batch(state, _batch(99))
+    s2, l2 = trainer2.train_on_batch(restored, _batch(99))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    saver.close()
+    saver2.close()
+
+
+def test_keep_max_rotation(tmp_path):
+    trainer = _trainer()
+    state = trainer.init_state(jax.random.PRNGKey(0), _batch()["features"])
+    saver = CheckpointSaver(str(tmp_path / "ckpt"), keep_max=2,
+                            async_save=False)
+    for i in range(4):
+        state, _ = trainer.train_on_batch(state, _batch(i))
+        saver.save(state, force=True)
+    saver.wait_until_finished()
+    assert saver.latest_step() == 4
+    steps = saver._mngr.all_steps()
+    assert len(steps) <= 2 and 4 in steps
+    saver.close()
+
+
+def test_maybe_restore_empty_dir_returns_none(tmp_path):
+    saver = CheckpointSaver(str(tmp_path / "empty"), async_save=False)
+    assert saver.maybe_restore(template=None) is None
+    saver.close()

@@ -1,0 +1,216 @@
+"""Elasticity integration: pod manager + fake k8s + rendezvous + real
+workers in threads, with mid-job preemption — the in-process equivalent of
+the reference's minikube chaos test (delete a worker pod mid-job, assert
+completion — SURVEY.md §4.4), plus the TPU re-mesh cycle.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.k8s_client import FakeK8sClient
+from elasticdl_tpu.data.reader import TFRecordDataReader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.pod_manager import PodManager
+from elasticdl_tpu.master.rendezvous_server import RendezvousServer
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    create_shards_from_ranges,
+)
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.parallel.elastic import ElasticMeshManager
+from elasticdl_tpu.proto.service import InProcessMasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    from model_zoo.mnist.data import write_dataset
+
+    root = tmp_path_factory.mktemp("mnist_elastic")
+    return write_dataset(str(root), n_train=512, n_val=64)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_model_spec("model_zoo", "mnist.mnist_functional_api.custom_model")
+
+
+class PreemptedError(BaseException):
+    """Simulated pod preemption (BaseException so the worker's task-level
+    error handling does NOT catch and report it — sudden death)."""
+
+
+class InProcessCluster:
+    """Pods are worker threads; FakeK8sClient events drive their life."""
+
+    def __init__(self, train_dir, spec, tm, servicer):
+        self.train_dir = train_dir
+        self.spec = spec
+        self.tm = tm
+        self.servicer = servicer
+        self.threads = {}
+        self.alive_flags = {}
+        self.workers = {}
+        self.k8s = FakeK8sClient()
+        # intercept pod create/delete -> start/kill threads
+        orig_create = self.k8s.create_pod
+        orig_delete = self.k8s.delete_pod
+
+        def create_pod(spec_):
+            orig_create(spec_)
+            if spec_.pod_type == "worker":
+                self._start_worker_thread(spec_.worker_id, spec_.name)
+
+        def delete_pod(name):
+            wid = next(
+                (w for w, n in list(self.pod_names.items()) if n == name),
+                None,
+            )
+            if wid is not None:
+                self.kill_worker(wid)  # process dies before DELETED event
+            orig_delete(name)
+
+        self.pod_names = {}
+        self.k8s.create_pod = create_pod
+        self.k8s.delete_pod = delete_pod
+
+    def kill_worker(self, worker_id):
+        """Kill the pod 'process' and wait for it to die — mirrors reality:
+        the k8s FAILED/DELETED event always trails the process's death, so
+        recover_tasks cannot race a still-leasing worker."""
+        self.alive_flags[worker_id].clear()
+        thread = self.threads.get(worker_id)
+        if thread is not None:
+            thread.join(timeout=60)
+
+    def _start_worker_thread(self, worker_id, pod_name):
+        self.pod_names[worker_id] = pod_name
+        alive = threading.Event()
+        alive.set()
+        self.alive_flags[worker_id] = alive
+        client = InProcessMasterClient(self.servicer)
+        reader = TFRecordDataReader(self.train_dir)
+        elastic = ElasticMeshManager(
+            client,
+            worker_id,
+            devices_for_world=lambda n: jax.devices()[: max(1, min(n, 8))],
+        )
+        worker = Worker(
+            worker_id=worker_id,
+            master_client=client,
+            data_reader=reader,
+            spec=self.spec,
+            minibatch_size=32,
+            elastic_manager=elastic,
+        )
+        self.workers[worker_id] = worker
+
+        # preemption check rides task processing
+        orig_process = worker._process_task
+
+        def guarded_process(task):
+            if not alive.is_set():
+                raise PreemptedError()
+            return orig_process(task)
+
+        worker._process_task = guarded_process
+
+        def run():
+            try:
+                worker.run()
+            except PreemptedError:
+                pass  # pod died silently
+
+        thread = threading.Thread(target=run, daemon=True)
+        self.threads[worker_id] = thread
+        thread.start()
+
+
+def test_preemption_mid_job_completes_with_remesh(mnist_data, spec):
+    train_dir, val_dir = mnist_data
+    reader = TFRecordDataReader(train_dir)
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges(
+            reader.create_shards(), records_per_task=64
+        ),
+        num_epochs=2,
+    )
+    rendezvous = RendezvousServer()
+    eval_service = EvaluationService(tm)
+    servicer = MasterServicer(
+        tm, evaluation_service=eval_service, rendezvous_server=rendezvous
+    )
+    cluster = InProcessCluster(train_dir, spec, tm, servicer)
+    pod_manager = PodManager(
+        cluster.k8s,
+        task_manager=tm,
+        rendezvous_server=rendezvous,
+        num_workers=2,
+        relaunch_on_worker_failure=2,
+    )
+    pod_manager.start()
+    assert len(pod_manager.alive_workers()) == 2
+    epoch_before = rendezvous.rendezvous_id
+
+    # Let worker 0 make progress, then preempt it (FAILED, like a spot kill)
+    deadline = time.time() + 60
+    while tm.counters.finished < 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert tm.counters.finished >= 2, "no progress before preemption"
+    cluster.kill_worker(0)
+    cluster.k8s.emit(cluster.pod_names[0], PodStatus.FAILED)
+
+    # pod manager must have: recovered tasks, bumped rendezvous, relaunched
+    deadline = time.time() + 120
+    while not tm.finished and time.time() < deadline:
+        time.sleep(0.1)
+    assert tm.finished, f"job did not finish: {tm.snapshot()}"
+    assert rendezvous.rendezvous_id > epoch_before
+    # replacement worker launched with a fresh id
+    assert any(w >= 2 for w in cluster.workers)
+    # all records trained at least once despite the kill
+    assert tm.counters.records_done >= 1024
+    # at least one surviving/replacement worker re-meshed mid-job
+    assert any(
+        w.trainer is not None
+        and w._elastic is not None
+        and w._elastic.remesh_count >= 1
+        for w in cluster.workers.values()
+    )
+    pod_manager.stop()
+
+
+def test_scale_down_recovers_tasks_gracefully(mnist_data, spec):
+    train_dir, _ = mnist_data
+    reader = TFRecordDataReader(train_dir)
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges(
+            reader.create_shards(), records_per_task=64
+        ),
+    )
+    rendezvous = RendezvousServer()
+    servicer = MasterServicer(tm, rendezvous_server=rendezvous)
+    cluster = InProcessCluster(train_dir, spec, tm, servicer)
+    pod_manager = PodManager(
+        cluster.k8s,
+        task_manager=tm,
+        rendezvous_server=rendezvous,
+        num_workers=3,
+    )
+    pod_manager.start()
+    assert len(pod_manager.alive_workers()) == 3
+    pod_manager.scale_down(1)
+    time.sleep(0.2)
+    assert len(pod_manager.alive_workers()) == 2
+    # DELETED pods are NOT relaunched (intentional scale-down)
+    deadline = time.time() + 120
+    while not tm.finished and time.time() < deadline:
+        time.sleep(0.1)
+    assert tm.finished
+    assert len(pod_manager.alive_workers()) == 2
+    pod_manager.stop()
