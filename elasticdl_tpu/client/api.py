@@ -1,0 +1,179 @@
+"""Client API: job construction and submission.
+
+Parity: reference elasticdl_client/api.py (SURVEY.md C18, call stack §3.1).
+`Local` strategy runs master + worker in-process (no cluster); cluster
+strategies build the master pod spec (command = `python -m
+elasticdl_tpu.master.main` with all flags re-serialized as argv — argv is
+the config wire format, as in the reference) and submit it through the
+Kubernetes client.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from elasticdl_tpu.common import args as args_lib
+from elasticdl_tpu.common.constants import DistributionStrategy, PodType
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def train(args) -> int:
+    if args.distribution_strategy == DistributionStrategy.LOCAL:
+        return _train_local(args)
+    return _submit_master_pod(args, job_type="train")
+
+
+def evaluate(args) -> int:
+    if args.distribution_strategy == DistributionStrategy.LOCAL:
+        return _train_local(args, job_type="evaluate")
+    return _submit_master_pod(args, job_type="evaluate")
+
+
+def predict(args) -> int:
+    if args.distribution_strategy == DistributionStrategy.LOCAL:
+        return _train_local(args, job_type="predict")
+    return _submit_master_pod(args, job_type="predict")
+
+
+def _train_local(args, job_type: str = "train") -> int:
+    """Master + worker(s) in one process: the zero-cluster path (and the
+    dev loop for model-zoo modules)."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.data.reader import create_data_reader
+    from elasticdl_tpu.master.main import Master
+    from elasticdl_tpu.proto.service import InProcessMasterClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    spec = get_model_spec(
+        args.model_zoo,
+        args.model_def,
+        model_params=args.model_params,
+        dataset_fn=args.dataset_fn,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+    )
+    args.job_type = job_type
+    if job_type in ("evaluate", "predict") and not args.checkpoint_dir_for_init:
+        raise ValueError(
+            f"elasticdl {job_type} requires --checkpoint_dir_for_init "
+            "(evaluating/predicting with random weights is meaningless)"
+        )
+    master = Master(args)
+    client = InProcessMasterClient(master.servicer)
+    data_origin = {
+        "train": args.training_data,
+        "evaluate": args.validation_data,
+        "predict": args.prediction_data,
+    }[job_type]
+    if spec.custom_data_reader is not None:
+        reader = spec.custom_data_reader(data_origin=data_origin)
+    else:
+        reader = create_data_reader(data_origin)
+
+    from elasticdl_tpu.common.save_utils import CheckpointSaver
+
+    init_saver = None
+    if job_type in ("evaluate", "predict"):
+        init_saver = CheckpointSaver(args.checkpoint_dir_for_init)
+        if init_saver.latest_step() is None:
+            raise ValueError(
+                f"--checkpoint_dir_for_init "
+                f"{args.checkpoint_dir_for_init!r} contains no checkpoint"
+            )
+
+    def make_saver(worker_id: int):
+        # evaluate/predict: every worker restores from the init checkpoint;
+        # train: worker 0 owns periodic checkpointing (optionally warm-
+        # started from checkpoint_dir_for_init).
+        if job_type in ("evaluate", "predict"):
+            return init_saver
+        if worker_id == 0 and args.checkpoint_dir:
+            return CheckpointSaver(
+                args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+            )
+        if worker_id == 0 and args.checkpoint_dir_for_init:
+            return CheckpointSaver(args.checkpoint_dir_for_init)
+        return None
+
+    workers = []
+    threads = []
+    for wid in range(args.num_workers):
+        worker = Worker(
+            worker_id=wid,
+            master_client=client,
+            data_reader=reader,
+            spec=spec,
+            minibatch_size=args.minibatch_size,
+            use_bf16=args.use_bf16,
+            checkpoint_saver=make_saver(wid),
+            checkpoint_steps=args.checkpoint_steps,
+        )
+        workers.append(worker)
+        thread = threading.Thread(target=worker.run, daemon=True)
+        threads.append(thread)
+        thread.start()
+    ok = master.wait()
+    for thread in threads:
+        thread.join(timeout=60)
+    for worker in workers:  # flush any in-flight async checkpoint writes
+        if worker._checkpoint_saver is not None:
+            worker._checkpoint_saver.wait_until_finished()
+    metrics = master.evaluation_service.latest_metrics()
+    if metrics:
+        logger.info("Final metrics: %s", metrics)
+    if job_type == "predict" and args.output:
+        import numpy as np
+
+        preds = [
+            p for w in workers
+            for p in getattr(w, "predictions", [])
+        ]
+        if preds:
+            os_path = args.output
+            if not os_path.endswith(".npy"):
+                import os
+
+                os.makedirs(os_path, exist_ok=True)
+                os_path = f"{os_path}/predictions.npy"
+            np.save(os_path, np.concatenate(preds))
+            logger.info("Wrote predictions to %s", os_path)
+    elif args.output and workers and workers[0].state is not None:
+        from elasticdl_tpu.common.export import export_model
+
+        export_model(workers[0].state, spec, args.output)
+        logger.info("Exported model to %s", args.output)
+    logger.info("Job %s: %s", "succeeded" if ok else "failed",
+                master.task_manager.snapshot())
+    return 0 if ok else 1
+
+
+def _submit_master_pod(args, job_type: str) -> int:
+    """Cluster mode: create the master pod through the Kubernetes API."""
+    from elasticdl_tpu.common.k8s_client import K8sClient, PodSpec
+
+    master_args = args_lib.build_arguments_from_parsed_result(
+        args, filter_args={"func"}
+    )
+    command = (
+        ["python", "-m", "elasticdl_tpu.master.main"]
+        + master_args
+        + ["--job_type", job_type]
+    )
+    client = K8sClient(namespace=args.namespace, job_name=args.job_name)
+    client.create_pod(
+        PodSpec(
+            name=f"{args.job_name}-master",
+            pod_type=PodType.MASTER,
+            image=args.image_name,
+            command=command,
+            resources={},
+        )
+    )
+    logger.info(
+        "Submitted master pod %s-master to namespace %s",
+        args.job_name, args.namespace,
+    )
+    return 0

@@ -1,0 +1,65 @@
+"""Job-image tooling: `elasticdl zoo init|build|push`.
+
+Parity: reference elasticdl_client image builder (SURVEY.md C18): generate
+a Dockerfile embedding the model zoo, build and push via the docker CLI
+(gated — absent docker, the generated Dockerfile is still written so CI
+images can be built elsewhere).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+# Build context is the model zoo's PARENT directory, so the COPY source is
+# always the context-relative zoo basename (absolute paths are forbidden
+# COPY sources).  The framework itself is pip-installed into the image.
+_DOCKERFILE = """\
+FROM {base_image}
+RUN pip install --no-cache-dir jax[tpu] flax optax orbax-checkpoint \\
+    grpcio protobuf numpy elasticdl-tpu
+COPY {zoo_basename} /app/model_zoo
+WORKDIR /app
+ENV PYTHONPATH=/app
+ENTRYPOINT ["python", "-m", "elasticdl_tpu.master.main"]
+"""
+
+
+def init_zoo(model_zoo: str, base_image: str = "python:3.12") -> int:
+    os.makedirs(model_zoo, exist_ok=True)
+    path = os.path.join(model_zoo, "Dockerfile")
+    zoo_basename = os.path.basename(os.path.abspath(model_zoo))
+    with open(path, "w") as f:
+        f.write(_DOCKERFILE.format(base_image=base_image,
+                                   zoo_basename=zoo_basename))
+    logger.info("Wrote %s", path)
+    return 0
+
+
+def build_image(model_zoo: str, image: str) -> int:
+    dockerfile = os.path.join(model_zoo, "Dockerfile")
+    if not os.path.exists(dockerfile):
+        init_zoo(model_zoo)
+    context = os.path.dirname(os.path.abspath(model_zoo)) or "."
+    if shutil.which("docker") is None:
+        logger.error(
+            "docker CLI not found; Dockerfile is at %s — build it on a "
+            "machine with docker (`docker build -f %s -t %s %s`)",
+            dockerfile, dockerfile, image, context,
+        )
+        return 1
+    return subprocess.call(
+        ["docker", "build", "-f", dockerfile, "-t", image, context]
+    )
+
+
+def push_image(image: str) -> int:
+    if shutil.which("docker") is None:
+        logger.error("docker CLI not found; cannot push %s", image)
+        return 1
+    return subprocess.call(["docker", "push", image])

@@ -1,0 +1,100 @@
+"""The `elasticdl` CLI.
+
+Parity: reference elasticdl_client/main.py (SURVEY.md C18):
+
+    elasticdl train    --model_zoo ... --model_def pkg.fn --training_data ...
+    elasticdl evaluate --model_zoo ... --validation_data ...
+    elasticdl predict  --model_zoo ... --prediction_data ...
+    elasticdl zoo init|build|push
+
+Flag surface mirrors the reference (SURVEY.md C21) so zoo jobs launch
+unchanged; TPU-specific flags (--use_bf16, mesh axes) extend it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from elasticdl_tpu.common import args as args_lib
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="elasticdl",
+        description="elasticdl-tpu: elastic distributed training on TPU",
+    )
+    subparsers = parser.add_subparsers(dest="command")
+
+    train_parser = subparsers.add_parser("train", help="submit a training job")
+    args_lib.add_common_params(train_parser)
+    args_lib.add_model_params(train_parser)
+    args_lib.add_train_params(train_parser)
+    train_parser.set_defaults(func="train")
+
+    eval_parser = subparsers.add_parser("evaluate", help="run evaluation")
+    args_lib.add_common_params(eval_parser)
+    args_lib.add_model_params(eval_parser)
+    args_lib.add_train_params(eval_parser)
+    eval_parser.set_defaults(func="evaluate")
+
+    predict_parser = subparsers.add_parser("predict", help="run prediction")
+    args_lib.add_common_params(predict_parser)
+    args_lib.add_model_params(predict_parser)
+    args_lib.add_train_params(predict_parser)
+    predict_parser.set_defaults(func="predict")
+
+    zoo_parser = subparsers.add_parser("zoo", help="model zoo image tools")
+    zoo_sub = zoo_parser.add_subparsers(dest="zoo_command")
+    zoo_init = zoo_sub.add_parser("init", help="scaffold a model zoo dir")
+    zoo_init.add_argument("--model_zoo", default="model_zoo")
+    zoo_init.add_argument("--base_image", default="python:3.12")
+    zoo_init.set_defaults(func="zoo_init")
+    zoo_build = zoo_sub.add_parser("build", help="build the job image")
+    zoo_build.add_argument("--model_zoo", default="model_zoo")
+    zoo_build.add_argument("--image", required=True)
+    zoo_build.set_defaults(func="zoo_build")
+    zoo_push = zoo_sub.add_parser("push", help="push the job image")
+    zoo_push.add_argument("image")
+    zoo_push.set_defaults(func="zoo_push")
+    return parser
+
+
+def main(argv=None) -> int:
+    parser = _build_parser()
+    # Strict parsing: a typo'd flag must error, not silently fall back to
+    # a default (the master/worker argv wire format stays tolerant via
+    # parse_known_args in common/args.py; the human-facing CLI does not).
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 2
+
+    from elasticdl_tpu.client import api, image_builder
+
+    if args.func in ("train", "evaluate", "predict"):
+        try:
+            return getattr(api, args.func)(args)
+        except (ImportError, ModuleNotFoundError) as exc:
+            print(
+                f"elasticdl {args.func}: cannot load --model_def "
+                f"{args.model_def!r} from --model_zoo {args.model_zoo!r}: "
+                f"{exc}",
+                file=sys.stderr,
+            )
+            return 1
+        except ValueError as exc:
+            print(f"elasticdl {args.func}: {exc}", file=sys.stderr)
+            return 1
+    if args.func == "zoo_init":
+        return image_builder.init_zoo(args.model_zoo, args.base_image)
+    if args.func == "zoo_build":
+        return image_builder.build_image(args.model_zoo, args.image)
+    if args.func == "zoo_push":
+        return image_builder.push_image(args.image)
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

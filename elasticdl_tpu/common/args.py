@@ -92,6 +92,7 @@ def add_train_params(parser: argparse.ArgumentParser):
     parser.add_argument("--grads_to_wait", type=pos_int, default=1)
     parser.add_argument("--training_data", default="")
     parser.add_argument("--validation_data", default="")
+    parser.add_argument("--prediction_data", default="")
     parser.add_argument("--evaluation_steps", type=non_neg_int, default=0)
     parser.add_argument("--evaluation_start_delay_secs", type=non_neg_int, default=0)
     parser.add_argument("--evaluation_throttle_secs", type=non_neg_int, default=0)

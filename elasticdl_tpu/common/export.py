@@ -1,0 +1,53 @@
+"""Final model export.
+
+Parity: reference ModelHandler.get_model_to_export + SavedModel export
+(SURVEY.md C9/C14).  The reference rewrote `elasticdl.Embedding` layers
+back to `keras.Embedding` before export; here the sharded tables are
+ordinary arrays in the param tree, so export is a gather-to-host plus
+serialization — no layer rewrite needed.
+
+Format: `params.msgpack` (flax serialization of {params, model_state}) +
+`export_meta.json` (module/model info for reloading).  Re-load with
+`load_exported` into a freshly constructed zoo model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def export_model(state, spec, output_dir: str) -> str:
+    os.makedirs(output_dir, exist_ok=True)
+    host_tree = {
+        "params": jax.tree.map(np.asarray, state.params),
+        "model_state": jax.tree.map(np.asarray, state.model_state),
+    }
+    path = os.path.join(output_dir, "params.msgpack")
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(host_tree))
+    meta = {
+        "step": int(state.step),
+        "module": getattr(spec.module, "__name__", None),
+        "model_class": type(spec.model).__name__,
+        "framework": "elasticdl-tpu",
+    }
+    with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    return path
+
+
+def load_exported(output_dir: str, template: Any):
+    """Restore exported variables into `template` (a {params, model_state}
+    dict with matching structure, e.g. from model.init)."""
+    with open(os.path.join(output_dir, "params.msgpack"), "rb") as f:
+        return serialization.from_bytes(template, f.read())

@@ -31,6 +31,7 @@ class Master:
 
     def __init__(self, args, data_reader=None, validation_reader=None):
         self.args = args
+        self.job_type = getattr(args, "job_type", "train")
         self._reader = data_reader
         self._val_reader = validation_reader
         if self._reader is None and args.training_data:
@@ -42,7 +43,7 @@ class Master:
             create_shards_from_ranges(
                 self._reader.create_shards(), args.records_per_task
             )
-            if self._reader
+            if self._reader and self.job_type == "train"
             else []
         )
         evaluation_shards = (
@@ -52,14 +53,29 @@ class Master:
             if self._val_reader
             else []
         )
+        prediction_shards = []
+        if getattr(args, "prediction_data", "") and self.job_type == "predict":
+            pred_reader = create_data_reader(args.prediction_data)
+            prediction_shards = create_shards_from_ranges(
+                pred_reader.create_shards(), args.records_per_task
+            )
+        if not (training_shards or evaluation_shards or prediction_shards):
+            raise ValueError(
+                f"job type {self.job_type!r} has no input data "
+                "(--training_data / --validation_data / --prediction_data)"
+            )
         self.task_manager = TaskManager(
             training_shards=training_shards,
             evaluation_shards=evaluation_shards,
+            prediction_shards=prediction_shards,
             num_epochs=args.num_epochs,
             lease_timeout_s=args.task_lease_timeout_s,
             shuffle_shards=True,
             shuffle_seed=0,
         )
+        # evaluate-only jobs: the eval round IS the job — inject upfront.
+        if self.job_type == "evaluate" and evaluation_shards:
+            self.task_manager.create_evaluation_tasks(model_version=0)
         self.evaluation_service = EvaluationService(
             self.task_manager,
             evaluation_steps=args.evaluation_steps,
@@ -81,7 +97,7 @@ class Master:
         # which workers can observe job_finished before the eval round).
         self._final_eval_done = False
         self._evaluation_shards = evaluation_shards
-        if evaluation_shards:
+        if evaluation_shards and self.job_type == "train":
             self.task_manager.add_pre_finish_provider(self._final_eval_tasks)
 
     # ---- lifecycle -----------------------------------------------------

@@ -99,7 +99,10 @@ class TaskManager:
         self._doing: Dict[int, _DoingEntry] = {}
         self._dead_workers: set = set()
         self._next_task_id = 0
-        self._epoch = 0
+        # Jobs without training data (evaluate/predict-only) have no epochs
+        # to run; start with the epoch requirement already satisfied so the
+        # job can finish once its eval/predict tasks drain.
+        self._epoch = 0 if training_shards else num_epochs
         self._task_retry_count: Dict[int, int] = {}
         self.counters = TaskCounters()
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []

@@ -29,6 +29,22 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
+# Trace-time mesh context: model code (e.g. ring attention inside a Flax
+# module) needs the mesh for shard_map, but zoo `custom_model()` factories
+# are mesh-agnostic.  The Trainer sets this before tracing/executing steps.
+_CURRENT_MESH: "Optional[Mesh]" = None
+
+
+def set_current_mesh(mesh: "Mesh") -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_current_mesh() -> "Mesh":
+    if _CURRENT_MESH is not None:
+        return _CURRENT_MESH
+    return create_mesh()
+
 
 def create_mesh(
     devices: Optional[Sequence[jax.Device]] = None,

@@ -1,0 +1,10 @@
+from elasticdl_tpu.preprocessing.layers import (  # noqa: F401
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+)

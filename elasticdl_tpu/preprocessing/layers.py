@@ -1,0 +1,186 @@
+"""Feature-preprocessing layers.
+
+Parity: reference elasticdl_preprocessing/layers/ (SURVEY.md C19): the same
+layer set with the same semantics — feature engineering expressed as
+composable layers so train and serve share code.  Host-facing layers
+(strings) run in `feed` on numpy; numeric layers are jnp-traceable and can
+also sit inside the jitted model.
+
+Layers: Hashing, IndexLookup, Discretization, ToNumber, RoundIdentity,
+LogRound, ConcatenateWithOffset, SparseEmbedding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:  # jnp where available; every numeric layer also accepts numpy
+    import jax.numpy as jnp
+except ImportError:  # pragma: no cover
+    jnp = np
+
+
+def fnv1a_hash(value: str) -> int:
+    """Stable 31-bit FNV-1a string hash (Python's hash() is per-process
+    salted; feature hashing must agree across workers and across
+    train/serve)."""
+    h = 2166136261
+    for byte in str(value).encode():
+        h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+_fnv1a = fnv1a_hash  # internal alias
+
+
+class Hashing:
+    """Hash strings/ints into [0, num_bins).  Stable across processes
+    (FNV-1a, not Python's salted hash)."""
+
+    def __init__(self, num_bins: int):
+        if num_bins <= 0:
+            raise ValueError("num_bins must be positive")
+        self.num_bins = num_bins
+
+    def __call__(self, x):
+        arr = np.asarray(x)
+        if arr.dtype.kind in ("U", "S", "O"):
+            flat = np.array(
+                [_fnv1a(v) % self.num_bins for v in arr.reshape(-1)],
+                dtype=np.int32,
+            )
+            return flat.reshape(arr.shape)
+        return (arr.astype(np.int64) % self.num_bins).astype(np.int32)
+
+
+class IndexLookup:
+    """Map vocabulary strings to indices; out-of-vocabulary -> num_oov
+    buckets appended after the vocab (reference semantics: OOV id =
+    len(vocabulary) when num_oov_indices == 1)."""
+
+    def __init__(self, vocabulary: Sequence[str], num_oov_indices: int = 1):
+        self.vocabulary = list(vocabulary)
+        self.num_oov_indices = max(1, num_oov_indices)
+        self._table = {v: i for i, v in enumerate(self.vocabulary)}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocabulary) + self.num_oov_indices
+
+    def __call__(self, x):
+        arr = np.asarray(x)
+
+        def lookup(value):
+            idx = self._table.get(str(value))
+            if idx is not None:
+                return idx
+            oov = _fnv1a(str(value)) % self.num_oov_indices
+            return len(self.vocabulary) + oov
+
+        flat = np.array(
+            [lookup(v) for v in arr.reshape(-1)], dtype=np.int32
+        )
+        return flat.reshape(arr.shape)
+
+
+class Discretization:
+    """Bucket floats by boundaries: x -> index in [0, len(bins)]."""
+
+    def __init__(self, bin_boundaries: Sequence[float]):
+        self.bin_boundaries = list(bin_boundaries)
+
+    def __call__(self, x):
+        boundaries = jnp.asarray(self.bin_boundaries)
+        return jnp.searchsorted(
+            boundaries, jnp.asarray(x, dtype=boundaries.dtype), side="right"
+        ).astype(jnp.int32)
+
+
+class ToNumber:
+    """Strings -> numbers with a default for empty/unparseable values."""
+
+    def __init__(self, out_type=np.float32, default_value=0):
+        self.out_type = out_type
+        self.default_value = default_value
+
+    def __call__(self, x):
+        arr = np.asarray(x)
+        if arr.dtype.kind not in ("U", "S", "O"):
+            return arr.astype(self.out_type)
+
+        def convert(value):
+            text = str(value).strip()
+            if not text:
+                return self.default_value
+            try:
+                return float(text)
+            except ValueError:
+                return self.default_value
+
+        flat = np.array(
+            [convert(v) for v in arr.reshape(-1)], dtype=self.out_type
+        )
+        return flat.reshape(arr.shape)
+
+
+class RoundIdentity:
+    """Round a numeric feature to an integer id, clipped to
+    [0, max_value)."""
+
+    def __init__(self, max_value: int):
+        self.max_value = max_value
+
+    def __call__(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        return jnp.clip(
+            jnp.round(x), 0, self.max_value - 1
+        ).astype(jnp.int32)
+
+
+class LogRound:
+    """round(log_base(x)) as an id for power-law numerics, clipped to
+    [0, max_value)."""
+
+    def __init__(self, max_value: int, base: float = np.e):
+        self.max_value = max_value
+        self.base = base
+
+    def __call__(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        safe = jnp.maximum(x, 1.0)
+        ids = jnp.round(jnp.log(safe) / np.log(self.base))
+        return jnp.clip(ids, 0, self.max_value - 1).astype(jnp.int32)
+
+
+class ConcatenateWithOffset:
+    """Concatenate id columns, offsetting each so they index disjoint
+    ranges of one shared embedding table."""
+
+    def __init__(self, offsets: Sequence[int], axis: int = -1):
+        self.offsets = list(offsets)
+        self.axis = axis
+
+    def __call__(self, inputs: List):
+        if len(inputs) != len(self.offsets):
+            raise ValueError(
+                f"{len(inputs)} inputs vs {len(self.offsets)} offsets"
+            )
+        shifted = [
+            jnp.asarray(x, jnp.int32) + offset
+            for x, offset in zip(inputs, self.offsets)
+        ]
+        return jnp.concatenate(shifted, axis=self.axis)
+
+
+def SparseEmbedding(input_dim: int, output_dim: int, combiner: str = "sum",
+                    **kwargs):
+    """Reference `SparseEmbedding` == bag-combining distributed embedding;
+    alias over layers.DistributedEmbedding (table sharded on the mesh)."""
+    from elasticdl_tpu.layers.embedding import DistributedEmbedding
+
+    return DistributedEmbedding(
+        input_dim=input_dim, output_dim=output_dim, combiner=combiner,
+        **kwargs,
+    )

@@ -36,8 +36,9 @@ logger = get_logger(__name__)
 
 class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
-    params: Any
+    params: Any          # trainable variables ({"params": ...})
     opt_state: Any
+    model_state: Any = struct.field(default_factory=dict)  # batch_stats etc.
 
 
 class Trainer:
@@ -66,6 +67,15 @@ class Trainer:
         self._param_sharding_fn = param_sharding_fn
         self._repl = mesh_lib.replicated(self.mesh)
         self._data = mesh_lib.data_sharding(self.mesh)
+        # Models with train-time behavior (BatchNorm, dropout) take a
+        # `train` kwarg per the zoo contract; plain models need not.
+        import inspect
+
+        try:
+            call_params = inspect.signature(type(model).__call__).parameters
+            self._has_train_kwarg = "train" in call_params
+        except (TypeError, ValueError):
+            self._has_train_kwarg = False
         self._build_steps()
 
     def set_mesh(self, mesh):
@@ -87,11 +97,20 @@ class Trainer:
     # ---- state ---------------------------------------------------------
 
     def init_state(self, rng, sample_features) -> TrainState:
-        params = self.model.init(rng, self._cast(sample_features))
+        mesh_lib.set_current_mesh(self.mesh)
+        kwargs = {"train": False} if self._has_train_kwarg else {}
+        variables = dict(
+            self.model.init(rng, self._cast(sample_features), **kwargs)
+        )
+        # Split trainable ("params") from mutable model state (e.g.
+        # batch_stats); the optimizer sees only the former.
+        params = {"params": variables.pop("params")}
+        model_state = variables
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=self.optimizer.init(params),
+            model_state=model_state,
         )
         return jax.device_put(state, self.state_sharding(state))
 
@@ -101,6 +120,7 @@ class Trainer:
         by sharded embedding tables / tensor parallelism)."""
         if self._param_sharding_fn is None:
             return jax.tree.map(lambda _: self._repl, state)
+        model_state_sh = jax.tree.map(lambda _: self._repl, state.model_state)
 
         def spec_for(path, leaf):
             spec = self._param_sharding_fn(path, leaf)
@@ -128,7 +148,12 @@ class Trainer:
         opt_sh = jax.tree.map(
             shard_subtree, state.opt_state, is_leaf=is_param_like
         )
-        return TrainState(step=self._repl, params=params_sh, opt_state=opt_sh)
+        return TrainState(
+            step=self._repl,
+            params=params_sh,
+            opt_state=opt_sh,
+            model_state=model_state_sh,
+        )
 
     def _cast(self, features):
         if not self.use_bf16:
@@ -143,15 +168,31 @@ class Trainer:
     # ---- steps ---------------------------------------------------------
 
     def _build_steps(self):
-        def loss_of(params, features, labels):
-            preds = self.model.apply(params, self._cast(features))
-            return jnp.asarray(
+        def loss_of(params, model_state, features, labels):
+            variables = {**params, **model_state}
+            kwargs = {"train": True} if self._has_train_kwarg else {}
+            mutable = list(model_state.keys())
+            if mutable:
+                preds, new_model_state = self.model.apply(
+                    variables, self._cast(features), mutable=mutable,
+                    **kwargs,
+                )
+            else:
+                preds = self.model.apply(
+                    variables, self._cast(features), **kwargs
+                )
+                new_model_state = model_state
+            loss = jnp.asarray(
                 self.loss_fn(labels, preds.astype(jnp.float32)), jnp.float32
             )
+            return loss, new_model_state
 
         def train_step(state: TrainState, batch):
-            loss, grads = jax.value_and_grad(loss_of)(
-                state.params, batch["features"], batch["labels"]
+            (loss, new_model_state), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(
+                state.params, state.model_state,
+                batch["features"], batch["labels"],
             )
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
@@ -159,13 +200,20 @@ class Trainer:
             params = optax.apply_updates(state.params, updates)
             return (
                 TrainState(
-                    step=state.step + 1, params=params, opt_state=opt_state
+                    step=state.step + 1,
+                    params=params,
+                    opt_state=opt_state,
+                    model_state=new_model_state,
                 ),
                 loss,
             )
 
         def eval_step(state: TrainState, features):
-            preds = self.model.apply(state.params, self._cast(features))
+            variables = {**state.params, **state.model_state}
+            kwargs = {"train": False} if self._has_train_kwarg else {}
+            preds = self.model.apply(
+                variables, self._cast(features), **kwargs
+            )
             return preds.astype(jnp.float32)
 
         # Shardings: batch split on `data`; XLA inserts the gradient
@@ -176,11 +224,13 @@ class Trainer:
     # ---- host-side helpers --------------------------------------------
 
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
+        mesh_lib.set_current_mesh(self.mesh)  # for mesh-aware model code
         batch = mesh_lib.shard_batch(batch, self.mesh)
         state, loss = self.train_step(state, batch)
         return state, loss
 
     def predict_on_batch(self, state, features):
+        mesh_lib.set_current_mesh(self.mesh)
         features = jax.tree.map(
             lambda x: jax.device_put(x, self._data), features
         )

@@ -1,0 +1,77 @@
+"""Synthetic census-income-like CSV data with planted structure (including
+an education x occupation interaction so the wide crosses carry signal)."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from model_zoo.census.wide_and_deep import COLUMNS
+
+_VOCAB = {
+    "workclass": [f"class_{i}" for i in range(8)],
+    "education": [f"edu_{i}" for i in range(16)],
+    "marital_status": [f"marital_{i}" for i in range(7)],
+    "occupation": [f"occ_{i}" for i in range(14)],
+    "relationship": [f"rel_{i}" for i in range(6)],
+    "race": [f"race_{i}" for i in range(5)],
+    "sex": ["male", "female"],
+    "native_country": [f"country_{i}" for i in range(40)],
+}
+
+
+def synthetic_census(n: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    planted = np.random.RandomState(11)
+    cat_values = {}
+    cat_weights = {}
+    for col, vocab in _VOCAB.items():
+        cat_values[col] = rng.randint(0, len(vocab), size=n)
+        cat_weights[col] = planted.randn(len(vocab)) * 0.5
+    age = rng.randint(17, 80, size=n)
+    gain = np.round(rng.exponential(500, size=n), 2)
+    loss_ = np.round(rng.exponential(100, size=n), 2)
+    hours = rng.randint(10, 70, size=n)
+
+    logits = (
+        0.04 * (age - 40)
+        + 0.0003 * gain
+        + 0.03 * (hours - 40)
+        + sum(cat_weights[c][cat_values[c]] for c in _VOCAB)
+        # planted cross: certain education x occupation combos pay
+        + 1.5 * ((cat_values["education"] + cat_values["occupation"]) % 5 == 0)
+        - 0.5
+    )
+    prob = 1.0 / (1.0 + np.exp(-logits))
+    labels = (rng.rand(n) < prob).astype(int)
+
+    rows = []
+    for i in range(n):
+        row = [
+            str(age[i]), str(gain[i]), str(loss_[i]), str(hours[i]),
+        ] + [
+            _VOCAB[c][cat_values[c][i]] for c in
+            ["workclass", "education", "marital_status", "occupation",
+             "relationship", "race", "sex", "native_country"]
+        ] + [str(labels[i])]
+        rows.append(row)
+    return rows
+
+
+def write_dataset(directory: str, n_train: int = 8192, n_val: int = 2048,
+                  seed: int = 0):
+    train_dir = os.path.join(directory, "train")
+    val_dir = os.path.join(directory, "val")
+    os.makedirs(train_dir, exist_ok=True)
+    os.makedirs(val_dir, exist_ok=True)
+    for path, n, s in [
+        (os.path.join(train_dir, "census-train.csv"), n_train, seed),
+        (os.path.join(val_dir, "census-val.csv"), n_val, seed + 1),
+    ]:
+        with open(path, "w", newline="") as f:
+            writer = csv.writer(f)
+            writer.writerow(COLUMNS)
+            writer.writerows(synthetic_census(n, s))
+    return train_dir, val_dir

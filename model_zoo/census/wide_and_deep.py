@@ -1,0 +1,113 @@
+"""Wide & Deep on Census-income-style data (BASELINE.md config #3).
+
+Zoo-contract port of the reference's census wide&deep model (SURVEY.md C20,
+the SQLFlow-generated variant) re-designed for TPU: categorical features go
+through mesh-sharded DistributedEmbedding tables; the wide half uses hashed
+cross features with dim-1 embeddings (the classic wide&deep recipe); the
+deep half is an MLP on the MXU.  Records come from the CSV reader (rows of
+strings), exercising the tabular data path.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.layers.embedding import (
+    DistributedEmbedding,
+    embedding_param_sharding,
+)
+from model_zoo.common.metrics import auc, binary_accuracy
+
+NUMERIC_COLS = ["age", "capital_gain", "capital_loss", "hours_per_week"]
+CATEGORICAL_COLS = [
+    "workclass", "education", "marital_status", "occupation",
+    "relationship", "race", "sex", "native_country",
+]
+LABEL_COL = "label"
+COLUMNS = NUMERIC_COLS + CATEGORICAL_COLS + [LABEL_COL]
+
+_CROSSES = [("education", "occupation"), ("marital_status", "relationship")]
+
+
+from elasticdl_tpu.preprocessing.layers import fnv1a_hash as _string_hash
+
+
+class WideAndDeep(nn.Module):
+    vocab_capacity: int = 4096
+    embed_dim: int = 8
+    mlp_dims: tuple = (64, 32)
+
+    @nn.compact
+    def __call__(self, features):
+        numeric = features["numeric"].astype(jnp.float32)   # (B, 4)
+        cat = features["categorical"].astype(jnp.int32)     # (B, 8)
+        cross = features["cross"].astype(jnp.int32)         # (B, 2)
+
+        numeric = jnp.log1p(jnp.abs(numeric))
+
+        # deep half: embeddings + numerics -> MLP
+        emb = DistributedEmbedding(
+            self.vocab_capacity, self.embed_dim, name="deep_embedding"
+        )(cat)                                              # (B, 8, k)
+        h = jnp.concatenate([numeric, emb.reshape(emb.shape[0], -1)], -1)
+        for i, width in enumerate(self.mlp_dims):
+            h = nn.relu(nn.Dense(width, name=f"mlp_{i}")(h))
+        deep = nn.Dense(1, name="deep_out")(h)[..., 0]
+
+        # wide half: dim-1 embeddings over raw + crossed categoricals
+        wide_ids = jnp.concatenate([cat, cross], axis=1)    # (B, 10)
+        wide = DistributedEmbedding(
+            self.vocab_capacity, 1, combiner="sum", name="wide_linear"
+        )(wide_ids)[..., 0]
+        wide = wide + nn.Dense(1, name="wide_numeric")(numeric)[..., 0]
+
+        return wide + deep  # logits
+
+
+def custom_model(vocab_capacity: int = 4096, embed_dim: int = 8):
+    return WideAndDeep(vocab_capacity=vocab_capacity, embed_dim=embed_dim)
+
+
+def loss(labels, predictions):
+    return optax.sigmoid_binary_cross_entropy(
+        predictions, labels.astype(jnp.float32)
+    ).mean()
+
+
+def optimizer(lr: float = 1e-3):
+    return optax.adam(lr)
+
+
+def feed(records, metadata=None):
+    """records: CSV rows ordered as COLUMNS (strings)."""
+    columns = (metadata or {}).get("columns") or COLUMNS
+    idx = {c: i for i, c in enumerate(columns)}
+    n = len(records)
+    numeric = np.empty((n, len(NUMERIC_COLS)), np.float32)
+    cat = np.empty((n, len(CATEGORICAL_COLS)), np.int32)
+    cross = np.empty((n, len(_CROSSES)), np.int32)
+    labels = np.empty((n,), np.int32)
+    for i, row in enumerate(records):
+        for j, col in enumerate(NUMERIC_COLS):
+            numeric[i, j] = float(row[idx[col]])
+        for j, col in enumerate(CATEGORICAL_COLS):
+            cat[i, j] = _string_hash(f"{col}={row[idx[col]]}")
+        for j, (a, b) in enumerate(_CROSSES):
+            cross[i, j] = _string_hash(
+                f"{a}x{b}={row[idx[a]]}|{row[idx[b]]}"
+            )
+        labels[i] = int(row[idx[LABEL_COL]])
+    return {
+        "features": {"numeric": numeric, "categorical": cat, "cross": cross},
+        "labels": labels,
+    }
+
+
+def eval_metrics_fn():
+    return {"auc": auc, "accuracy": binary_accuracy}
+
+
+param_sharding = embedding_param_sharding

@@ -1,0 +1,42 @@
+"""Synthetic CIFAR-10-like data (class-conditional colored patterns over
+32x32x3; record = 3072 image bytes + 1 label byte)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from elasticdl_tpu.data.record_io import write_tfrecords
+
+IMG_BYTES = 32 * 32 * 3
+
+
+def synthetic_cifar(n: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n)
+    proto = np.random.RandomState(77).rand(10, IMG_BYTES) * 255
+    images = proto[labels] + rng.randn(n, IMG_BYTES) * 40
+    return (
+        np.clip(images, 0, 255).astype(np.uint8),
+        labels.astype(np.uint8),
+    )
+
+
+def write_dataset(directory: str, n_train: int = 1024, n_val: int = 256,
+                  seed: int = 0):
+    train_dir = os.path.join(directory, "train")
+    val_dir = os.path.join(directory, "val")
+    os.makedirs(train_dir, exist_ok=True)
+    os.makedirs(val_dir, exist_ok=True)
+    xt, yt = synthetic_cifar(n_train, seed)
+    write_tfrecords(
+        os.path.join(train_dir, "cifar-00000.tfrecord"),
+        (img.tobytes() + bytes([int(lbl)]) for img, lbl in zip(xt, yt)),
+    )
+    xv, yv = synthetic_cifar(n_val, seed + 1)
+    write_tfrecords(
+        os.path.join(val_dir, "cifar-val.tfrecord"),
+        (img.tobytes() + bytes([int(lbl)]) for img, lbl in zip(xv, yv)),
+    )
+    return train_dir, val_dir

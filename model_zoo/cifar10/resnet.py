@@ -1,0 +1,115 @@
+"""ResNet-50 for CIFAR-10 (BASELINE.md config #2 — the dense-gradient
+AllReduce/psum scaling config).
+
+Zoo-contract port of the reference's model_zoo ResNet-50 (SURVEY.md C20)
+as a Flax module: bottleneck-block ResNet-v1.5 with a CIFAR stem (3x3
+conv, no initial max-pool).  bf16-friendly: all convs/matmuls run on the
+MXU; batch norm statistics stay f32.
+
+Record format: 32*32*3 image bytes + 1 label byte = 3073 bytes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from model_zoo.common.metrics import binary_accuracy  # noqa: F401 (zoo symmetry)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: type = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            self.norm, use_running_average=not train, momentum=0.9,
+            dtype=jnp.float32,
+        )
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            use_bias=False,
+        )(y)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1),
+                strides=(self.strides, self.strides), use_bias=False,
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape(x.shape[0], 32, 32, 3)
+        x = nn.Conv(64, (3, 3), use_bias=False)(x)  # CIFAR stem
+        x = nn.relu(
+            nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        )
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(64 * 2**stage, strides=strides)(
+                    x, train=train
+                )
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def custom_model(stage_sizes=(3, 4, 6, 3)):
+    return ResNet(stage_sizes=tuple(stage_sizes))
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 0.1):
+    return optax.sgd(lr, momentum=0.9)
+
+
+IMG_BYTES = 32 * 32 * 3
+
+
+def feed(records, metadata=None):
+    images, labels = [], []
+    for record in records:
+        if isinstance(record, dict):
+            images.append(np.asarray(record["image"], np.float32))
+            labels.append(int(record["label"]))
+        else:
+            arr = np.frombuffer(record, dtype=np.uint8)
+            images.append(arr[:IMG_BYTES].astype(np.float32))
+            labels.append(int(arr[IMG_BYTES]))
+    features = (np.stack(images) / 255.0 - 0.5).astype(np.float32)
+    return {
+        "features": features,
+        "labels": np.asarray(labels, np.int32),
+    }
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: float(
+            np.mean(np.argmax(predictions, axis=-1) == labels)
+        ),
+    }

@@ -1,0 +1,120 @@
+"""DeepFM for Criteo-style CTR data — the north-star config
+(BASELINE.md #4).  Zoo-contract port of the reference's
+model_zoo/deepfm* (SURVEY.md C20) re-designed TPU-first:
+
+- all 26 sparse fields share ONE DistributedEmbedding table (row-sharded
+  over the mesh `model` axis) addressed by field-offset ids — a single
+  large gather per step instead of 26 small ones keeps the lookup and its
+  scatter-add gradient efficient on TPU;
+- FM second-order term uses the square-of-sum trick (two reductions, no
+  O(fields^2) pairwise products);
+- the deep tower is a plain MLP on the MXU.
+
+Record format (TFRecord payload): 13 float32 dense | 26 int32 sparse ids |
+1 uint8 label = 157 bytes (see model_zoo.deepfm.data).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.layers.embedding import (
+    DistributedEmbedding,
+    embedding_param_sharding,
+)
+from model_zoo.common.metrics import auc, binary_accuracy
+
+NUM_DENSE = 13
+NUM_SPARSE = 26
+
+
+class DeepFM(nn.Module):
+    vocab_capacity: int = 1 << 18  # shared table rows (hash space)
+    embed_dim: int = 16
+    mlp_dims: tuple = (256, 128)
+
+    @nn.compact
+    def __call__(self, features):
+        dense = features["dense"].astype(jnp.float32)      # (B, 13)
+        sparse = features["sparse"].astype(jnp.int32)      # (B, 26)
+        # field-offset ids so the shared table separates fields before
+        # hashing (hash mixing declusters the offsets)
+        offsets = jnp.arange(NUM_SPARSE, dtype=jnp.int32) * jnp.int32(
+            0x61C88647  # int32-safe odd mixing constant (2^32/phi >> 1)
+        )
+        field_ids = sparse + offsets[None, :]
+
+        # second-order / deep embeddings: (B, 26, k)
+        emb = DistributedEmbedding(
+            self.vocab_capacity, self.embed_dim, hash_input=True,
+            name="fm_embedding",
+        )(field_ids)
+        # first-order weights: (B, 26, 1)
+        first = DistributedEmbedding(
+            self.vocab_capacity, 1, hash_input=True, name="fm_linear",
+        )(field_ids)
+
+        # FM second order: 0.5 * sum_k [ (sum_f v)^2 - sum_f v^2 ]
+        sum_f = jnp.sum(emb, axis=1)
+        fm2 = 0.5 * jnp.sum(sum_f * sum_f - jnp.sum(emb * emb, axis=1), axis=-1)
+
+        dense_n = jnp.log1p(jnp.abs(dense)) * jnp.sign(dense)
+        wide = nn.Dense(1, name="dense_linear")(dense_n)[..., 0]
+
+        deep_in = jnp.concatenate(
+            [dense_n, emb.reshape(emb.shape[0], -1)], axis=-1
+        )
+        h = deep_in
+        for i, width in enumerate(self.mlp_dims):
+            h = nn.relu(nn.Dense(width, name=f"mlp_{i}")(h))
+        deep = nn.Dense(1, name="mlp_out")(h)[..., 0]
+
+        return wide + jnp.sum(first[..., 0], axis=1) + fm2 + deep  # logits
+
+
+def custom_model(vocab_capacity: int = 1 << 18, embed_dim: int = 16):
+    return DeepFM(vocab_capacity=vocab_capacity, embed_dim=embed_dim)
+
+
+def loss(labels, predictions):
+    return optax.sigmoid_binary_cross_entropy(
+        predictions, labels.astype(jnp.float32)
+    ).mean()
+
+
+def optimizer(lr: float = 1e-3):
+    return optax.adam(lr)
+
+
+RECORD_BYTES = NUM_DENSE * 4 + NUM_SPARSE * 4 + 1
+
+
+def feed(records, metadata=None):
+    dense = np.empty((len(records), NUM_DENSE), np.float32)
+    sparse = np.empty((len(records), NUM_SPARSE), np.int32)
+    labels = np.empty((len(records),), np.int32)
+    for i, record in enumerate(records):
+        if isinstance(record, dict):
+            dense[i] = record["dense"]
+            sparse[i] = record["sparse"]
+            labels[i] = record["label"]
+        else:
+            dense[i] = np.frombuffer(record, np.float32, NUM_DENSE, 0)
+            sparse[i] = np.frombuffer(
+                record, np.int32, NUM_SPARSE, NUM_DENSE * 4
+            )
+            labels[i] = record[RECORD_BYTES - 1]
+    return {
+        "features": {"dense": dense, "sparse": sparse},
+        "labels": labels,
+    }
+
+
+def eval_metrics_fn():
+    return {"auc": auc, "accuracy": binary_accuracy}
+
+
+param_sharding = embedding_param_sharding

@@ -1,0 +1,141 @@
+"""CLI end-to-end: `elasticdl train --distribution_strategy Local ...`
+runs the full job (the reference's flag surface — SURVEY.md C18/C21),
+including export + reload of the final model."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.client.main import main as cli_main
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    from model_zoo.mnist.data import write_dataset
+
+    root = tmp_path_factory.mktemp("mnist_cli")
+    return write_dataset(str(root), n_train=256, n_val=64)
+
+
+def test_cli_train_local_with_export(mnist_data, tmp_path):
+    train_dir, val_dir = mnist_data
+    output = str(tmp_path / "export")
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api.custom_model",
+            "--training_data", train_dir,
+            "--validation_data", val_dir,
+            "--distribution_strategy", "Local",
+            "--num_epochs", "1",
+            "--minibatch_size", "32",
+            "--records_per_task", "64",
+            "--output", output,
+        ]
+    )
+    assert rc == 0
+    assert os.path.exists(os.path.join(output, "params.msgpack"))
+    meta = json.load(open(os.path.join(output, "export_meta.json")))
+    assert meta["framework"] == "elasticdl-tpu"
+    assert meta["step"] > 0
+
+    # reload the export and run inference
+    import jax
+
+    from elasticdl_tpu.common.export import load_exported
+    from elasticdl_tpu.common.model_handler import get_model_spec
+
+    spec = get_model_spec(
+        "model_zoo", "mnist.mnist_functional_api.custom_model"
+    )
+    x = np.zeros((4, 784), np.float32)
+    variables = spec.model.init(jax.random.PRNGKey(0), x)
+    template = {
+        "params": {"params": variables["params"]},
+        "model_state": {},
+    }
+    restored = load_exported(output, template)
+    preds = spec.model.apply(
+        {"params": restored["params"]["params"]}, x
+    )
+    assert preds.shape == (4, 10)
+
+
+def test_cli_no_command_prints_help(capsys):
+    assert cli_main([]) == 2
+
+
+def test_cli_zoo_init(tmp_path):
+    zoo = str(tmp_path / "zoo")
+    assert cli_main(["zoo", "init", "--model_zoo", zoo]) == 0
+    assert os.path.exists(os.path.join(zoo, "Dockerfile"))
+
+
+def test_cli_train_checkpoint_evaluate_predict_chain(mnist_data, tmp_path):
+    """train -> checkpoint -> evaluate (restores, no training) ->
+    predict (writes predictions)."""
+    train_dir, val_dir = mnist_data
+    ckpt = str(tmp_path / "ckpt")
+    common = [
+        "--model_zoo", "model_zoo",
+        "--model_def", "mnist.mnist_functional_api.custom_model",
+        "--distribution_strategy", "Local",
+        "--minibatch_size", "32",
+        "--records_per_task", "64",
+    ]
+    rc = cli_main(
+        ["train", *common, "--training_data", train_dir,
+         "--num_epochs", "1", "--checkpoint_dir", ckpt,
+         "--checkpoint_steps", "4"]
+    )
+    assert rc == 0
+    rc = cli_main(
+        ["evaluate", *common, "--validation_data", val_dir,
+         "--checkpoint_dir_for_init", ckpt]
+    )
+    assert rc == 0
+    out = str(tmp_path / "preds")
+    rc = cli_main(
+        ["predict", *common, "--prediction_data", val_dir,
+         "--checkpoint_dir_for_init", ckpt, "--output", out]
+    )
+    assert rc == 0
+    preds = np.load(os.path.join(out, "predictions.npy"))
+    assert preds.shape == (64, 10)
+
+
+def test_cli_evaluate_without_checkpoint_errors(mnist_data):
+    _, val_dir = mnist_data
+    rc = cli_main(
+        ["evaluate", "--model_zoo", "model_zoo",
+         "--model_def", "mnist.mnist_functional_api.custom_model",
+         "--validation_data", val_dir,
+         "--distribution_strategy", "Local"]
+    )
+    assert rc == 1  # clean error, no hang
+
+
+def test_cli_unknown_flag_rejected():
+    with pytest.raises(SystemExit):
+        cli_main(["train", "--trainning_data", "/nope"])
+
+
+def test_cli_train_two_local_workers(mnist_data):
+    train_dir, _ = mnist_data
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api.custom_model",
+            "--training_data", train_dir,
+            "--distribution_strategy", "Local",
+            "--num_workers", "2",
+            "--num_epochs", "1",
+            "--minibatch_size", "32",
+            "--records_per_task", "64",
+        ]
+    )
+    assert rc == 0

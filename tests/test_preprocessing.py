@@ -1,0 +1,93 @@
+"""Golden tests for the preprocessing layers (SURVEY.md C19 semantics)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.preprocessing import (
+    ConcatenateWithOffset,
+    Discretization,
+    Hashing,
+    IndexLookup,
+    LogRound,
+    RoundIdentity,
+    SparseEmbedding,
+    ToNumber,
+)
+
+
+def test_hashing_strings_stable_and_in_range():
+    layer = Hashing(num_bins=16)
+    a = layer(np.array([["apple", "banana"], ["apple", ""]]))
+    b = layer(np.array([["apple", "banana"], ["apple", ""]]))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 2)
+    assert a[0, 0] == a[1, 0]  # same string, same bin
+    assert ((a >= 0) & (a < 16)).all()
+
+
+def test_hashing_ints():
+    layer = Hashing(num_bins=10)
+    out = layer(np.array([1, 11, 21]))
+    np.testing.assert_array_equal(out, [1, 1, 1])
+
+
+def test_index_lookup_vocab_and_oov():
+    layer = IndexLookup(["cat", "dog", "bird"])
+    out = layer(np.array(["dog", "cat", "fish", "bird"]))
+    assert out[0] == 1 and out[1] == 0 and out[3] == 2
+    assert out[2] == 3  # single OOV bucket after vocab
+    assert layer.vocab_size == 4
+
+
+def test_index_lookup_multiple_oov_buckets():
+    layer = IndexLookup(["a"], num_oov_indices=4)
+    outs = {int(layer(np.array([w]))[0]) for w in
+            ["w1", "w2", "w3", "w4", "w5", "w6"]}
+    assert outs <= {1, 2, 3, 4}
+    assert layer.vocab_size == 5
+
+
+def test_discretization_golden():
+    layer = Discretization([0.0, 1.0, 10.0])
+    out = np.asarray(layer(np.array([-5.0, 0.0, 0.5, 1.0, 3.0, 100.0])))
+    np.testing.assert_array_equal(out, [0, 1, 1, 2, 2, 3])
+
+
+def test_to_number_defaults_and_parse():
+    layer = ToNumber(out_type=np.float32, default_value=-1)
+    out = layer(np.array(["1.5", "", "oops", " 2 "]))
+    np.testing.assert_allclose(out, [1.5, -1.0, -1.0, 2.0])
+    # numeric passthrough
+    np.testing.assert_allclose(layer(np.array([3, 4])), [3.0, 4.0])
+
+
+def test_round_identity_clips():
+    layer = RoundIdentity(max_value=10)
+    out = np.asarray(layer(np.array([0.4, 5.6, 99.0, -3.0])))
+    np.testing.assert_array_equal(out, [0, 6, 9, 0])
+
+
+def test_log_round_power_law():
+    layer = LogRound(max_value=10, base=10.0)
+    out = np.asarray(layer(np.array([1.0, 10.0, 1000.0, 1e12, 0.0])))
+    np.testing.assert_array_equal(out, [0, 1, 3, 9, 0])
+
+
+def test_concatenate_with_offset():
+    layer = ConcatenateWithOffset(offsets=[0, 100])
+    out = np.asarray(
+        layer([np.array([[1], [2]]), np.array([[3], [4]])])
+    )
+    np.testing.assert_array_equal(out, [[1, 103], [2, 104]])
+    with pytest.raises(ValueError):
+        layer([np.array([1])])
+
+
+def test_sparse_embedding_is_distributed_bag():
+    import jax
+
+    layer = SparseEmbedding(64, 8, combiner="sum")
+    ids = np.array([[1, 2, -1]])
+    params = layer.init(jax.random.PRNGKey(0), ids)
+    out = layer.apply(params, ids)
+    assert out.shape == (1, 8)
