@@ -1,67 +1,139 @@
 """Benchmark entry point: prints ONE JSON line with the headline metric.
 
-Runs on the real TPU chip (platform `axon` on this machine).  The headline
-config tracks BASELINE.md: until DeepFM/Criteo (north star) lands, the
-benchmark is the MNIST CNN train step.  The reference publishes no numbers
-(BASELINE.json `published: {}`), so `vs_baseline` is measured against the
-eager, un-jitted step on the same hardware — i.e. the speedup XLA
-compilation delivers over the reference's eager execution model, which is
-the apples-to-apples claim available on this machine.
+Headline config tracks BASELINE.md #4 (north star): DeepFM on Criteo-style
+data — the sparse-embedding stress path (the reference's PS-mode flagship).
+Runs on the real TPU chip.  The reference publishes no numbers
+(BASELINE.json `published: {}`), so `vs_baseline` is 1.0 by definition
+until a measured cross-round baseline exists (the driver records
+BENCH_r{N}.json each round).
+
+Secondary benches (run with `python bench.py all`): MNIST CNN, BERT ring
+attention.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
-import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+_ZOO = os.path.join(_ROOT, "model_zoo")
 
 
-def bench_mnist(batch_size: int = 256, iters: int = 50):
-    import jax
-    import numpy as np
-
+def _trainer_for(model_def: str, model_params: str = "", use_bf16=False):
     from elasticdl_tpu.common.model_handler import get_model_spec
     from elasticdl_tpu.worker.trainer import Trainer
 
-    import os
+    spec = get_model_spec(_ZOO, model_def, model_params=model_params)
+    return spec, Trainer(
+        model=spec.model,
+        optimizer=spec.optimizer,
+        loss_fn=spec.loss,
+        use_bf16=use_bf16,
+        param_sharding_fn=spec.param_sharding,
+    )
 
-    zoo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "model_zoo")
-    spec = get_model_spec(zoo, "mnist.mnist_functional_api.custom_model")
-    trainer = Trainer(
-        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
+
+def bench_deepfm(batch_size: int = 4096, iters: int = 30):
+    import jax
+
+    spec, trainer = _trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        model_params="vocab_capacity=1048576;embed_dim=16",
     )
     rng = np.random.RandomState(0)
     batch = {
-        "features": rng.rand(batch_size, 784).astype(np.float32),
-        "labels": rng.randint(0, 10, batch_size).astype(np.int32),
+        "features": {
+            "dense": rng.rand(batch_size, 13).astype(np.float32),
+            "sparse": rng.randint(
+                0, 1 << 24, size=(batch_size, 26)
+            ).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, batch_size).astype(np.int32),
     }
     state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
-    steps_per_sec, state = trainer.timed_steps_per_sec(
-        state, batch, iters=iters
-    )
-
-    # The reference publishes no numbers (BASELINE.json `published: {}`),
-    # so vs_baseline is 1.0 by definition until a measured cross-round
-    # baseline exists (the driver records BENCH_r{N}.json each round).
+    steps_per_sec, _ = trainer.timed_steps_per_sec(state, batch, iters=iters)
     return {
-        "metric": "mnist_cnn_train_examples_per_sec",
+        "metric": "deepfm_criteo_train_examples_per_sec",
         "value": round(steps_per_sec * batch_size, 1),
         "unit": "examples/sec",
         "vs_baseline": 1.0,
         "detail": {
             "steps_per_sec": round(steps_per_sec, 2),
             "batch_size": batch_size,
-            "device": str(jax.devices()[0]),
+            "vocab_capacity": 1 << 20,
+            "embed_dim": 16,
+            "device": str(__import__("jax").devices()[0]),
         },
     }
 
 
-def main():
-    import os, sys as _sys
+def bench_mnist(batch_size: int = 256, iters: int = 50):
+    import jax
 
-    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    result = bench_mnist()
-    print(json.dumps(result))
+    spec, trainer = _trainer_for("mnist.mnist_functional_api.custom_model")
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(batch_size, 784).astype(np.float32),
+        "labels": rng.randint(0, 10, batch_size).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    steps_per_sec, _ = trainer.timed_steps_per_sec(state, batch, iters=iters)
+    return {
+        "metric": "mnist_cnn_train_examples_per_sec",
+        "value": round(steps_per_sec * batch_size, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+        "detail": {"steps_per_sec": round(steps_per_sec, 2),
+                   "batch_size": batch_size},
+    }
+
+
+def bench_bert(batch_size: int = 32, seq_len: int = 512, iters: int = 10):
+    import jax
+
+    spec, trainer = _trainer_for(
+        "bert.bert_finetune.custom_model",
+        model_params=(
+            f"hidden=768;num_layers=12;heads=12;mlp_dim=3072;"
+            f"max_len={seq_len}"
+        ),
+        use_bf16=True,
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "input_ids": rng.randint(
+                0, 8192, size=(batch_size, seq_len)
+            ).astype(np.int32)
+        },
+        "labels": rng.randint(0, 2, batch_size).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    steps_per_sec, _ = trainer.timed_steps_per_sec(state, batch, iters=iters)
+    return {
+        "metric": "bert_base_finetune_examples_per_sec",
+        "value": round(steps_per_sec * batch_size, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+        "detail": {"steps_per_sec": round(steps_per_sec, 2),
+                   "batch_size": batch_size, "seq_len": seq_len},
+    }
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "deepfm"
+    if which == "all":
+        for fn in (bench_deepfm, bench_mnist, bench_bert):
+            print(json.dumps(fn()))
+    else:
+        fn = {"deepfm": bench_deepfm, "mnist": bench_mnist,
+              "bert": bench_bert}[which]
+        print(json.dumps(fn()))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,73 @@
+"""Profiling/tracing utilities.
+
+The reference had only coarse log-line timing (SURVEY.md §5); here the
+baseline is step timing with device synchronisation plus one-call access to
+the JAX profiler (Perfetto/XPlane traces TensorBoard can read).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+from typing import Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class StepTimer:
+    """Rolling step-rate meter.  `tick()` after each train step; reads are
+    O(1).  Use `synchronize=True` at measurement boundaries only (it calls
+    block_until_ready, which would serialize the pipeline every step)."""
+
+    def __init__(self, window: int = 100):
+        self._times = deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self, result=None, synchronize: bool = False):
+        if synchronize and result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    @property
+    def steps_per_sec(self) -> float:
+        if not self._times:
+            return 0.0
+        return len(self._times) / sum(self._times)
+
+    def log(self, prefix: str = ""):
+        logger.info("%ssteps/sec=%.2f", prefix, self.steps_per_sec)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace viewable in TensorBoard/Perfetto:
+
+        with profiler.trace("/tmp/trace"):
+            state, loss = trainer.train_on_batch(state, batch)
+            jax.block_until_ready(loss)
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("Profiler trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Name a region so it shows up in profiler timelines."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

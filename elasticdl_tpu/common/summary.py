@@ -1,0 +1,46 @@
+"""TensorBoard scalar summaries (optional — gated on TensorFlow being
+importable, matching the reference's optional TensorBoard service,
+SURVEY.md §5)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class SummaryWriter:
+    """Thin tf.summary wrapper; a no-op when TF is unavailable or no
+    log_dir is configured."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._writer = None
+        if not log_dir:
+            return
+        try:
+            import tensorflow as tf
+
+            self._writer = tf.summary.create_file_writer(log_dir)
+        except ImportError:
+            logger.warning(
+                "TensorFlow unavailable; summaries to %s disabled", log_dir
+            )
+
+    def scalars(self, values: Dict[str, float], step: int):
+        if self._writer is None:
+            return
+        import tensorflow as tf
+
+        with self._writer.as_default():
+            for name, value in values.items():
+                tf.summary.scalar(name, value, step=step)
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()

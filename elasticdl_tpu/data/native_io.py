@@ -1,0 +1,120 @@
+"""ctypes bindings for the native TFRecord scanner (native/recordio.cc).
+
+The shared library is built by `make -C native` (or scripts/build_native.sh)
+— attempted automatically once per process if g++ is available.  All
+callers degrade to the pure-Python implementation when the library is
+missing, so the native path is a pure accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SO_PATH = os.path.join(_ROOT, "native", "build", "librecordio.so")
+
+_lib = None
+_build_attempted = False
+
+
+def _try_build() -> None:
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    makefile = os.path.join(_ROOT, "native", "Makefile")
+    if not os.path.exists(makefile):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(_ROOT, "native")],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, OSError):
+        pass
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH):
+        _try_build()
+    if not os.path.exists(_SO_PATH):
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.recordio_build_index.restype = ctypes.c_int64
+    lib.recordio_build_index.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+    ]
+    lib.recordio_read_records.restype = ctypes.c_int64
+    lib.recordio_read_records.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+    ]
+    lib.recordio_free.restype = None
+    lib.recordio_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_index(path: str) -> List[int]:
+    lib = _load()
+    assert lib is not None
+    out = ctypes.POINTER(ctypes.c_int64)()
+    n = lib.recordio_build_index(path.encode(), ctypes.byref(out))
+    if n < 0:
+        raise IOError(f"native index build failed for {path} (rc={n})")
+    try:
+        return out[:n]
+    finally:
+        lib.recordio_free(out)
+
+
+def read_records(
+    path: str, offsets: List[int], start: int, end: int,
+    check_crc: bool = False,
+) -> Optional[List[bytes]]:
+    lib = _load()
+    assert lib is not None
+    end = min(end, len(offsets))
+    if start >= end:
+        return []
+    arr = (ctypes.c_int64 * len(offsets))(*offsets)
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    sizes = ctypes.POINTER(ctypes.c_int64)()
+    total = lib.recordio_read_records(
+        path.encode(), arr, start, end, int(check_crc),
+        ctypes.byref(data), ctypes.byref(sizes),
+    )
+    if total < 0:
+        raise IOError(f"native record read failed for {path} (rc={total})")
+    try:
+        blob = bytes(bytearray(data[:total]))
+        result = []
+        pos = 0
+        for i in range(end - start):
+            size = sizes[i]
+            result.append(blob[pos : pos + size])
+            pos += size
+        return result
+    finally:
+        lib.recordio_free(data)
+        lib.recordio_free(sizes)

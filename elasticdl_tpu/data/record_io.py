@@ -169,6 +169,12 @@ class TFRecordReader:
     def read(self, start: int, end: Optional[int] = None) -> Iterator[bytes]:
         """Yield payloads for records in [start, end)."""
         end = len(self._offsets) if end is None else min(end, len(self._offsets))
+        native = _try_native()
+        if native is not None:
+            yield from native.read_records(
+                self._path, self._offsets, start, end, self._check_crc
+            )
+            return
         for i in range(start, end):
             self._f.seek(self._offsets[i])
             header = self._f.read(8)

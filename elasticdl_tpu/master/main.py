@@ -167,6 +167,9 @@ def main(argv=None):
     metrics = master.evaluation_service.latest_metrics()
     if metrics:
         logger.info("Final metrics: %s", metrics)
+    # Linger so workers polling get_task observe job_finished and exit
+    # cleanly instead of hitting a torn-down server mid-RPC.
+    time.sleep(5.0)
     master.stop()
 
 

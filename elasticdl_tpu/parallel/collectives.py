@@ -1,0 +1,44 @@
+"""Collective-ops surface.
+
+Parity: reference python/collective_ops/ + Horovod wrapper (SURVEY.md C15).
+On TPU these are XLA collectives over ICI/DCN; inside `jit` they are
+emitted automatically from shardings, and inside `shard_map` they are the
+explicit `jax.lax` primitives re-exported here.  This module exists so
+framework code has ONE place naming the communication vocabulary; there is
+deliberately no hand-rolled ring — XLA owns scheduling and fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.parallel.mesh import DATA_AXIS
+
+# explicit collectives for shard_map code
+psum = jax.lax.psum
+pmean = jax.lax.pmean
+pmax = jax.lax.pmax
+pmin = jax.lax.pmin
+all_gather = jax.lax.all_gather
+ppermute = jax.lax.ppermute
+all_to_all = jax.lax.all_to_all
+axis_index = jax.lax.axis_index
+
+
+def allreduce_mean_gradients(grads, axis_name: str = DATA_AXIS):
+    """Explicit DP gradient averaging for shard_map-style training loops.
+    (The jit/NamedSharding path does not need this — the partitioner
+    inserts the reduction.)"""
+    return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), grads)
+
+
+def broadcast_from(value, root: int = 0, axis_name: str = DATA_AXIS):
+    """Broadcast `value` from shard `root` to all shards of `axis_name`
+    (the Horovod broadcast-variables-on-init equivalent, used after an
+    elastic re-init when a replacement worker must adopt rank 0's state)."""
+    idx = jax.lax.axis_index(axis_name)
+    masked = jax.tree.map(
+        lambda v: jnp.where(idx == root, v, jnp.zeros_like(v)), value
+    )
+    return jax.tree.map(lambda v: jax.lax.psum(v, axis_name), masked)

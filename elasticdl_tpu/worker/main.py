@@ -58,7 +58,23 @@ def main(argv=None):
     else:
         reader = create_data_reader(args.training_data)
 
+    from elasticdl_tpu.common.save_utils import CheckpointSaver
+    from elasticdl_tpu.parallel.elastic import ElasticMeshManager
     from elasticdl_tpu.worker.worker import Worker
+
+    # Cluster mode: membership epochs drive jax.distributed re-init and
+    # mesh rebuilds; checkpoints are how state survives a re-mesh on real
+    # multi-host topologies.
+    elastic = None
+    if args.distribution_strategy != "Local" and args.num_workers > 1:
+        elastic = ElasticMeshManager(
+            client, worker_id, use_jax_distributed=True
+        )
+    saver = None
+    if args.checkpoint_dir:
+        saver = CheckpointSaver(
+            args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+        )
 
     worker = Worker(
         worker_id=worker_id,
@@ -67,6 +83,9 @@ def main(argv=None):
         spec=spec,
         minibatch_size=args.minibatch_size,
         use_bf16=args.use_bf16,
+        elastic_manager=elastic,
+        checkpoint_saver=saver,
+        checkpoint_steps=args.checkpoint_steps,
     )
     ok = worker.run()
     logger.info("Worker %d exiting (clean=%s)", worker_id, ok)

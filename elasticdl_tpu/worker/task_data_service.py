@@ -20,23 +20,53 @@ from elasticdl_tpu.proto import elasticdl_pb2 as pb
 logger = get_logger(__name__)
 
 
+def _is_rpc_error(exc: Exception) -> bool:
+    try:
+        import grpc
+
+        return isinstance(exc, grpc.RpcError)
+    except ImportError:  # pragma: no cover
+        return False
+
+
 class TaskDataService:
     def __init__(self, master_client, data_reader, worker_id: int,
-                 wait_sleep_s: float = 0.5):
+                 wait_sleep_s: float = 0.5, master_grace_s: float = 30.0):
         self._client = master_client
         self._reader = data_reader
         self._worker_id = worker_id
         self._wait_sleep_s = wait_sleep_s
+        self.master_grace_s = master_grace_s
 
     def get_task(self, task_type=None) -> Tuple[Optional[pb.Task], bool]:
         """Poll the master for a task.  Returns (task|None, job_finished);
-        blocks through WAIT responses with backoff."""
+        blocks through WAIT responses with backoff.  Transient RPC failures
+        are retried; a master unreachable for `master_grace_s` means the
+        job is over (master exits after completion) or lost — either way
+        the worker must stop."""
+        deadline = None
         while True:
             req = pb.GetTaskRequest(worker_id=self._worker_id)
             if task_type is not None:
                 req.task_type = task_type
                 req.filter_by_type = True
-            resp = self._client.get_task(req)
+            try:
+                resp = self._client.get_task(req)
+                deadline = None
+            except Exception as exc:  # grpc.RpcError and friends
+                if not _is_rpc_error(exc):
+                    raise
+                now = time.time()
+                if deadline is None:
+                    deadline = now + self.master_grace_s
+                if now > deadline:
+                    logger.error(
+                        "Master unreachable for %.0fs; worker %d stopping",
+                        self.master_grace_s, self._worker_id,
+                    )
+                    return None, True
+                time.sleep(self._wait_sleep_s)
+                continue
             if resp.job_finished:
                 return None, True
             task = resp.task
@@ -52,7 +82,17 @@ class TaskDataService:
             worker_id=self._worker_id,
         )
         req.exec_counters["records"] = records
-        self._client.report_task_result(req)
+        try:
+            self._client.report_task_result(req)
+        except Exception as exc:
+            if not _is_rpc_error(exc):
+                raise
+            # Lost report: the master's lease timeout / failure detector
+            # re-queues the task (at-least-once contract).
+            logger.warning(
+                "report_task_result for task %d failed: %s",
+                task.task_id, exc,
+            )
 
     def batches_for_task(
         self,

@@ -94,12 +94,15 @@ class Worker:
                 records = self._process_task(task)
                 self._data_service.report_task(task, records=records)
                 if task.type == pb.TRAINING and self.state is not None:
-                    self._client.report_version(
-                        pb.ReportVersionRequest(
-                            worker_id=self.worker_id,
-                            model_version=int(self.state.step),
+                    try:
+                        self._client.report_version(
+                            pb.ReportVersionRequest(
+                                worker_id=self.worker_id,
+                                model_version=int(self.state.step),
+                            )
                         )
-                    )
+                    except Exception:
+                        pass  # advisory only; eval scheduling catches up
             except Exception as exc:  # report failure; master re-queues
                 logger.error(
                     "Task %d failed on worker %d: %s",

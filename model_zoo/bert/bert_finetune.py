@@ -1,0 +1,151 @@
+"""BERT sequence-classification fine-tune (BASELINE.md config #5 — the
+elasticity headline config, and the long-context flagship).
+
+Zoo-contract port of the reference's BERT fine-tune example (SURVEY.md
+C20), re-designed TPU-first:
+
+- attention is RING attention over the mesh `seq` axis
+  (elasticdl_tpu.ops.ring_attention): K/V blocks rotate over ICI with
+  online-softmax accumulation, so sequence length scales with the number
+  of chips — capability the reference does not have (SURVEY.md §5:
+  upstream has no SP/CP);
+- the token-embedding table is a DistributedEmbedding row-sharded over the
+  `model` axis;
+- everything else (QKV projections, MLP) is MXU matmuls that XLA shards
+  from the batch/sequence NamedShardings.
+
+Record format: max_len int32 token ids | 1 uint8 label.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.layers.embedding import (
+    DistributedEmbedding,
+    embedding_param_sharding,
+)
+from elasticdl_tpu.ops.ring_attention import ring_self_attention
+from elasticdl_tpu.parallel.mesh import get_current_mesh
+from model_zoo.common.metrics import auc, binary_accuracy
+
+MAX_LEN = 128
+VOCAB_SIZE = 8192
+
+
+class RingSelfAttention(nn.Module):
+    hidden: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x):
+        batch, length, _ = x.shape
+        head_dim = self.hidden // self.heads
+        qkv = nn.Dense(3 * self.hidden, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (batch, length, self.heads, head_dim)
+        out = ring_self_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            mesh=get_current_mesh(), causal=False,
+        )
+        return nn.Dense(self.hidden, name="out")(
+            out.reshape(batch, length, self.hidden)
+        )
+
+
+class TransformerBlock(nn.Module):
+    hidden: int
+    heads: int
+    mlp_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        y = RingSelfAttention(self.hidden, self.heads, name="attention")(x)
+        x = nn.LayerNorm()(x + y)
+        y = nn.Dense(self.mlp_dim)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden)(y)
+        return nn.LayerNorm()(x + y)
+
+
+class BertClassifier(nn.Module):
+    vocab_size: int = VOCAB_SIZE
+    hidden: int = 768
+    num_layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = MAX_LEN
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, features):
+        ids = features["input_ids"].astype(jnp.int32)      # (B, L)
+        tok = DistributedEmbedding(
+            self.vocab_size, self.hidden, hash_input=False,
+            name="token_embedding",
+        )(ids)
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.hidden),
+        )
+        x = tok + pos[None, : ids.shape[1]]
+        x = nn.LayerNorm()(x)
+        for i in range(self.num_layers):
+            x = TransformerBlock(
+                self.hidden, self.heads, self.mlp_dim, name=f"layer_{i}"
+            )(x)
+        # max-pool over sequence: sharp feature detection, and ring-
+        # friendly (a cross-shard reduce, no CLS gather from one shard)
+        pooled = jnp.max(x, axis=1)
+        logits = nn.Dense(self.num_classes, name="classifier")(pooled)
+        return logits
+
+
+def custom_model(hidden: int = 768, num_layers: int = 12, heads: int = 12,
+                 mlp_dim: int = 3072, max_len: int = MAX_LEN,
+                 vocab_size: int = VOCAB_SIZE):
+    return BertClassifier(
+        vocab_size=vocab_size, hidden=hidden, num_layers=num_layers,
+        heads=heads, mlp_dim=mlp_dim, max_len=max_len,
+    )
+
+
+def loss(labels, predictions):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        predictions, labels.astype(jnp.int32)
+    ).mean()
+
+
+def optimizer(lr: float = 2e-5):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def feed(records, metadata=None, max_len: int = MAX_LEN):
+    ids = np.empty((len(records), max_len), np.int32)
+    labels = np.empty((len(records),), np.int32)
+    for i, record in enumerate(records):
+        if isinstance(record, dict):
+            ids[i] = record["input_ids"]
+            labels[i] = record["label"]
+        else:
+            ids[i] = np.frombuffer(record, np.int32, max_len, 0)
+            labels[i] = record[max_len * 4]
+    return {"features": {"input_ids": ids}, "labels": labels}
+
+
+def eval_metrics_fn():
+    return {
+        "accuracy": lambda labels, predictions: float(
+            np.mean(np.argmax(predictions, -1) == labels)
+        ),
+        "auc": lambda labels, predictions: auc(
+            labels, predictions[:, 1] - predictions[:, 0]
+        ),
+    }
+
+
+param_sharding = embedding_param_sharding

@@ -1,0 +1,142 @@
+// Native TFRecord container scanner/reader for elasticdl-tpu.
+//
+// Role parity with the reference's native data/kernel path (SURVEY.md
+// C16/C17: Go PS + Eigen kernels): on TPU the optimizer math is XLA's job,
+// so the native speedup target is the host data plane — index builds and
+// record scans over TFRecord shards, which the task manager does when
+// cutting shards and workers do per leased task.  The wire format matches
+// data/record_io.py:
+//   uint64 length | uint32 masked_crc32c(length) | payload
+//   | uint32 masked_crc32c(payload)
+//
+// Exposed via a C ABI consumed with ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+uint32_t kCrcTable[256];
+
+struct TableInit {
+  TableInit() {
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int j = 0; j < 8; ++j)
+        crc = (crc & 1) ? (crc >> 1) ^ poly : crc >> 1;
+      kCrcTable[i] = crc;
+    }
+  }
+} table_init;
+
+uint32_t Crc32c(const uint8_t* data, size_t n) {
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = kCrcTable[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t MaskedCrc(const uint8_t* data, size_t n) {
+  uint32_t crc = Crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scans the file, writing record byte-offsets into *out (malloc'd; caller
+// frees via recordio_free).  Returns record count, or -1 on IO error,
+// -2 on truncation/corruption.
+int64_t recordio_build_index(const char* path, int64_t** out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::vector<int64_t> offsets;
+  std::fseek(f, 0, SEEK_END);
+  const int64_t size = std::ftell(f);
+  int64_t pos = 0;
+  uint8_t header[12];
+  while (pos < size) {
+    if (std::fseek(f, pos, SEEK_SET) != 0 ||
+        std::fread(header, 1, 12, f) != 12) {
+      std::fclose(f);
+      return -2;
+    }
+    uint64_t length;
+    std::memcpy(&length, header, 8);
+    const int64_t next = pos + 8 + 4 + static_cast<int64_t>(length) + 4;
+    if (next > size) {
+      std::fclose(f);
+      return -2;
+    }
+    offsets.push_back(pos);
+    pos = next;
+  }
+  std::fclose(f);
+  *out = static_cast<int64_t*>(std::malloc(offsets.size() * sizeof(int64_t)));
+  std::memcpy(*out, offsets.data(), offsets.size() * sizeof(int64_t));
+  return static_cast<int64_t>(offsets.size());
+}
+
+// Reads records [start, end) given their offsets, concatenating payloads
+// into *out (malloc'd) and writing per-record payload sizes into
+// *sizes_out (malloc'd, end-start entries).  check_crc != 0 validates
+// both CRCs.  Returns total payload bytes, or negative on error.
+int64_t recordio_read_records(const char* path, const int64_t* offsets,
+                              int64_t start, int64_t end, int check_crc,
+                              uint8_t** out, int64_t** sizes_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::vector<uint8_t> buffer;
+  std::vector<int64_t> sizes;
+  uint8_t header[12];
+  for (int64_t i = start; i < end; ++i) {
+    if (std::fseek(f, offsets[i], SEEK_SET) != 0 ||
+        std::fread(header, 1, 12, f) != 12) {
+      std::fclose(f);
+      return -2;
+    }
+    uint64_t length;
+    std::memcpy(&length, header, 8);
+    if (check_crc) {
+      uint32_t stored;
+      std::memcpy(&stored, header + 8, 4);
+      if (stored != MaskedCrc(header, 8)) {
+        std::fclose(f);
+        return -3;
+      }
+    }
+    const size_t old = buffer.size();
+    buffer.resize(old + length);
+    uint8_t footer[4];
+    if (std::fread(buffer.data() + old, 1, length, f) != length ||
+        std::fread(footer, 1, 4, f) != 4) {
+      std::fclose(f);
+      return -2;
+    }
+    if (check_crc) {
+      uint32_t stored;
+      std::memcpy(&stored, footer, 4);
+      if (stored != MaskedCrc(buffer.data() + old, length)) {
+        std::fclose(f);
+        return -3;
+      }
+    }
+    sizes.push_back(static_cast<int64_t>(length));
+  }
+  std::fclose(f);
+  *out = static_cast<uint8_t*>(std::malloc(buffer.size()));
+  std::memcpy(*out, buffer.data(), buffer.size());
+  *sizes_out =
+      static_cast<int64_t*>(std::malloc(sizes.size() * sizeof(int64_t)));
+  std::memcpy(*sizes_out, sizes.data(), sizes.size() * sizeof(int64_t));
+  return static_cast<int64_t>(buffer.size());
+}
+
+void recordio_free(void* ptr) { std::free(ptr); }
+
+}  // extern "C"

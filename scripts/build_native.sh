@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+set -euo pipefail
+make -C "$(dirname "$0")/../native" "$@"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# CI entry point (SURVEY.md C23 parity): unit + in-process integration
+# tests on a virtual 8-device CPU mesh, then the native-component build.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,71 @@
+"""BERT + ring attention end-to-end on a data=2 x model=2 x seq=2 mesh:
+3D parallelism (DP + sharded embeddings + sequence parallelism) in one
+training job.  The planted task (first token == last token) is learnable
+only through cross-shard attention."""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.args import parse_master_args
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.data.reader import TFRecordDataReader
+from elasticdl_tpu.master.main import Master
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.proto.service import InProcessMasterClient
+from elasticdl_tpu.worker.worker import Worker
+
+
+@pytest.fixture(scope="module")
+def pairs_data(tmp_path_factory):
+    from model_zoo.bert.data import write_dataset
+
+    root = tmp_path_factory.mktemp("bert_pairs")
+    return write_dataset(
+        str(root), n_train=4096, n_val=256, max_len=32, vocab=16
+    )
+
+
+def test_bert_ring_attention_learns_long_range(pairs_data):
+    train_dir, val_dir = pairs_data
+    spec = get_model_spec(
+        "model_zoo",
+        "bert.bert_finetune.custom_model",
+        model_params=(
+            "hidden=64;num_layers=2;heads=4;mlp_dim=128;max_len=32;"
+            "vocab_size=16;lr=0.003"
+        ),
+    )
+    # feed must agree with the tiny max_len
+    import functools
+
+    spec.feed = functools.partial(spec.feed, max_len=32)
+    args = parse_master_args(
+        [
+            "--training_data", train_dir,
+            "--validation_data", val_dir,
+            "--records_per_task", "512",
+            "--num_epochs", "6",
+            "--minibatch_size", "64",
+        ]
+    )
+    master = Master(args)
+    client = InProcessMasterClient(master.servicer)
+    mesh = mesh_lib.create_mesh(jax.devices(), data=2, model=2, seq=2)
+    worker = Worker(
+        worker_id=0,
+        master_client=client,
+        data_reader=TFRecordDataReader(train_dir),
+        spec=spec,
+        minibatch_size=64,
+        mesh=mesh,
+    )
+    assert worker.run()
+    metrics = master.evaluation_service.latest_metrics()
+    assert metrics is not None
+    # chance = 0.5; the long-range compare must be learned through ring
+    # attention across seq shards
+    assert metrics["accuracy"] > 0.9, f"accuracy too low: {metrics}"
+    # token embedding sharded over model axis
+    table = worker.state.params["params"]["token_embedding"]["embedding"]
+    assert table.addressable_shards[0].data.shape[0] == table.shape[0] // 2

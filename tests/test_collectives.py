@@ -1,0 +1,35 @@
+"""Collectives surface: broadcast_from under shard_map (the Horovod
+broadcast-on-init equivalent) and explicit gradient pmean."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.parallel import collectives, mesh as mesh_lib
+from jax.sharding import PartitionSpec as P
+
+
+def test_broadcast_from_rank0():
+    mesh = mesh_lib.create_mesh(jax.devices(), data=8)
+
+    def body(x):
+        return collectives.broadcast_from(x, root=0)
+
+    x = jnp.arange(8, dtype=jnp.float32)  # shard i holds value i
+    out = jax.shard_map(
+        body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(8))
+
+
+def test_allreduce_mean_gradients():
+    mesh = mesh_lib.create_mesh(jax.devices(), data=8)
+
+    def body(g):
+        return collectives.allreduce_mean_gradients({"w": g})["w"]
+
+    g = jnp.arange(8, dtype=jnp.float32)
+    out = jax.shard_map(
+        body, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+    )(g)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))

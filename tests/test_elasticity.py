@@ -185,6 +185,46 @@ def test_preemption_mid_job_completes_with_remesh(mnist_data, spec):
     pod_manager.stop()
 
 
+def test_survives_two_preemptions(mnist_data, spec):
+    """North-star elasticity criterion (BASELINE.md #5): the job survives
+    >= 2 worker preemptions and completes with full data coverage."""
+    train_dir, _ = mnist_data
+    reader = TFRecordDataReader(train_dir)
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges(
+            reader.create_shards(), records_per_task=64
+        ),
+        num_epochs=2,
+    )
+    rendezvous = RendezvousServer()
+    servicer = MasterServicer(tm, rendezvous_server=rendezvous)
+    cluster = InProcessCluster(train_dir, spec, tm, servicer)
+    pod_manager = PodManager(
+        cluster.k8s,
+        task_manager=tm,
+        rendezvous_server=rendezvous,
+        num_workers=2,
+        relaunch_on_worker_failure=3,
+    )
+    pod_manager.start()
+
+    for victim in (0, 1):
+        deadline = time.time() + 60
+        while tm.counters.finished < 2 * (victim + 1) and time.time() < deadline:
+            time.sleep(0.05)
+        cluster.kill_worker(victim)
+        cluster.k8s.emit(cluster.pod_names[victim], PodStatus.FAILED)
+
+    deadline = time.time() + 180
+    while not tm.finished and time.time() < deadline:
+        time.sleep(0.1)
+    assert tm.finished, f"job did not survive 2 preemptions: {tm.snapshot()}"
+    assert tm.counters.records_done >= 1024
+    # both replacements were launched
+    assert len(cluster.workers) >= 4
+    pod_manager.stop()
+
+
 def test_scale_down_recovers_tasks_gracefully(mnist_data, spec):
     train_dir, _ = mnist_data
     reader = TFRecordDataReader(train_dir)

@@ -1,0 +1,86 @@
+"""Ring attention vs full attention: numerical equivalence (forward and
+backward) on a data=2 x seq=4 mesh, causal and bidirectional."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops.ring_attention import (
+    full_attention_reference,
+    ring_self_attention,
+)
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+
+def _qkv(batch=2, length=32, heads=4, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (batch, length, heads, dim)
+    return tuple(
+        jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.5)
+        for _ in range(3)
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mesh_lib.create_mesh(jax.devices(), data=2, seq=4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(mesh, causal):
+    q, k, v = _qkv()
+    ring = ring_self_attention(q, k, v, mesh, causal=causal)
+    full = full_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(full), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match(mesh, causal):
+    q, k, v = _qkv(length=16)
+
+    def ring_loss(q, k, v):
+        return (ring_self_attention(q, k, v, mesh, causal=causal) ** 2).sum()
+
+    def full_loss(q, k, v):
+        return (full_attention_reference(q, k, v, causal=causal) ** 2).sum()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_full):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4
+        )
+
+
+def test_ring_under_jit_with_sharded_inputs(mesh):
+    """The production path: jit + sharded inputs; output sharding
+    preserved on (data, seq)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q, k, v = _qkv(length=64)
+    sharding = NamedSharding(mesh, P("data", "seq", None, None))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+
+    @jax.jit
+    def fn(q, k, v):
+        return ring_self_attention(q, k, v, mesh, causal=True)
+
+    out = fn(q, k, v)
+    full = full_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(full), atol=2e-5, rtol=2e-5
+    )
+    assert out.sharding.spec == P("data", "seq", None, None)
+
+
+def test_seq_axis_one_degenerates_cleanly():
+    mesh = mesh_lib.create_mesh(jax.devices()[:2], data=2, seq=1)
+    q, k, v = _qkv(length=16)
+    out = ring_self_attention(q, k, v, mesh, causal=False)
+    full = full_attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(full), atol=2e-5, rtol=2e-5
+    )
