@@ -1,0 +1,42 @@
+"""Virtual CPU device-mesh environment setup.
+
+Multi-chip code paths (DP psum, sharded embeddings, ring attention) are
+exercised without TPUs by forcing jax onto a virtual n-device CPU mesh —
+the CI strategy SURVEY.md §4 prescribes. This helper is the single place
+that builds that environment; tests/conftest.py and the driver's
+`dryrun_multichip` re-exec both use it so the flag-patching logic cannot
+drift.
+
+Stdlib-only: must be importable before jax (env vars have to be set
+before the backend initialises).
+"""
+
+from __future__ import annotations
+
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
+    """Return a copy of `base` (default os.environ) patched for an
+    n-device virtual CPU mesh.
+
+    Always *overrides* any existing device-count flag rather than keeping
+    a stale (possibly smaller) value — a smaller inherited count would
+    otherwise leave the child short of devices.
+    """
+    import os
+
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(rf"{_COUNT_FLAG}=\d+\s*", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    return env
+
+
+def apply_cpu_mesh_env(n_devices: int) -> None:
+    """Patch os.environ in place (for conftest-style early setup)."""
+    import os
+
+    os.environ.update(cpu_mesh_env(n_devices))

@@ -10,12 +10,11 @@ mocked k8s layer).
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elasticdl_tpu.common.virtual_mesh import apply_cpu_mesh_env  # noqa: E402
+
+apply_cpu_mesh_env(8)
 
 # This machine's sitecustomize force-registers the axon TPU plugin and
 # overrides jax_platforms to "axon,cpu"; point jax back at CPU before any
@@ -23,5 +22,3 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
