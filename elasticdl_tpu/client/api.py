@@ -84,19 +84,39 @@ def _train_local(args, job_type: str = "train") -> int:
                 f"{args.checkpoint_dir_for_init!r} contains no checkpoint"
             )
 
-    def make_saver(worker_id: int):
-        # evaluate/predict: every worker restores from the init checkpoint;
-        # train: worker 0 owns periodic checkpointing (optionally warm-
-        # started from checkpoint_dir_for_init).
+    def make_saver():
+        # evaluate/predict: restore from the init checkpoint; train:
+        # periodic checkpointing (optionally warm-started from
+        # checkpoint_dir_for_init).
         if job_type in ("evaluate", "predict"):
             return init_saver
-        if worker_id == 0 and args.checkpoint_dir:
+        if args.checkpoint_dir:
             return CheckpointSaver(
                 args.checkpoint_dir, keep_max=args.keep_checkpoint_max
             )
-        if worker_id == 0 and args.checkpoint_dir_for_init:
+        if args.checkpoint_dir_for_init:
             return CheckpointSaver(args.checkpoint_dir_for_init)
         return None
+
+    # ONE model for the whole job: all worker threads share a ModelOwner
+    # (trainer + state + update lock), so every task's gradients land in
+    # the same params — the consistency the reference provided via its
+    # PS/AllReduce machinery.  Per-worker private replicas would silently
+    # train N diverging models on 1/N of the data each.
+    from elasticdl_tpu.worker.sync import ModelOwner
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    owner = ModelOwner(
+        Trainer(
+            model=spec.model,
+            optimizer=spec.optimizer,
+            loss_fn=spec.loss,
+            use_bf16=args.use_bf16,
+            param_sharding_fn=spec.param_sharding,
+        ),
+        checkpoint_saver=make_saver(),
+        checkpoint_steps=args.checkpoint_steps,
+    )
 
     workers = []
     threads = []
@@ -107,9 +127,7 @@ def _train_local(args, job_type: str = "train") -> int:
             data_reader=reader,
             spec=spec,
             minibatch_size=args.minibatch_size,
-            use_bf16=args.use_bf16,
-            checkpoint_saver=make_saver(wid),
-            checkpoint_steps=args.checkpoint_steps,
+            model_owner=owner,
         )
         workers.append(worker)
         thread = threading.Thread(target=worker.run, daemon=True)
@@ -118,9 +136,9 @@ def _train_local(args, job_type: str = "train") -> int:
     ok = master.wait()
     for thread in threads:
         thread.join(timeout=60)
-    for worker in workers:  # flush any in-flight async checkpoint writes
-        if worker._checkpoint_saver is not None:
-            worker._checkpoint_saver.wait_until_finished()
+    if owner.checkpoint_saver is not None:
+        # flush any in-flight async checkpoint writes
+        owner.checkpoint_saver.wait_until_finished()
     metrics = master.evaluation_service.latest_metrics()
     if metrics:
         logger.info("Final metrics: %s", metrics)
@@ -140,10 +158,10 @@ def _train_local(args, job_type: str = "train") -> int:
                 os_path = f"{os_path}/predictions.npy"
             np.save(os_path, np.concatenate(preds))
             logger.info("Wrote predictions to %s", os_path)
-    elif args.output and workers and workers[0].state is not None:
+    elif args.output and owner.state is not None:
         from elasticdl_tpu.common.export import export_model
 
-        export_model(workers[0].state, spec, args.output)
+        export_model(owner.state, spec, args.output)
         logger.info("Exported model to %s", args.output)
     logger.info("Job %s: %s", "succeeded" if ok else "failed",
                 master.task_manager.snapshot())

@@ -25,10 +25,13 @@ class MasterServicer:
         rendezvous_server=None,
         pod_manager=None,
     ):
+        from elasticdl_tpu.master.spmd_assigner import SpmdAssigner
+
         self._tm = task_manager
         self._eval = evaluation_service
         self._rendezvous = rendezvous_server
         self._pod_manager = pod_manager
+        self._spmd = SpmdAssigner(task_manager, rendezvous_server)
         self._worker_liveness = {}
         self._max_model_version = 0
 
@@ -44,6 +47,13 @@ class MasterServicer:
                 task=pb.Task(task_id=-1, type=pb.WAIT), job_finished=True
             )
         return pb.GetTaskResponse(task=pb.Task(task_id=-1, type=pb.WAIT))
+
+    def get_spmd_task(
+        self, req: pb.GetSpmdTaskRequest, ctx
+    ) -> pb.SpmdTaskResponse:
+        """Group-synchronized leasing: every rank asking for the same
+        (epoch, seq) receives the identical task (master/spmd_assigner.py)."""
+        return self._spmd.get(req)
 
     def report_task_result(self, req: pb.ReportTaskResultRequest, ctx):
         self._tm.report(

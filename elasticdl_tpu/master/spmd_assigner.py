@@ -1,0 +1,85 @@
+"""Group-synchronized task assignment for SPMD training.
+
+In cluster mode all worker processes execute ONE collective train step over
+a global mesh (worker/spmd.py), so every rank must consume the identical
+task sequence.  The reference never faced this problem — its PS workers
+trained independently on disjoint shards and the PS merged their gradients
+(SURVEY.md §3.3) — but under SPMD the *assignment itself* is the thing to
+synchronize: the first rank to ask for (epoch, seq) triggers a real lease
+from the TaskManager on behalf of the group; every other rank gets the
+cached identical answer.  Failure semantics are unchanged from the
+reference's task-lease design (C3): the group holds the lease, an epoch
+bump (membership change) recovers all in-flight group leases and starts a
+fresh assignment sequence.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+logger = get_logger(__name__)
+
+# Group lease owner ids live far above real worker ids; one id per epoch so
+# recover_tasks() on an epoch bump can blacklist the stale owner without
+# touching the new epoch's leases.
+SPMD_GROUP_BASE = 1 << 20
+
+
+class SpmdAssigner:
+    def __init__(self, task_manager, rendezvous_server=None):
+        self._tm = task_manager
+        self._rendezvous = rendezvous_server
+        self._lock = threading.Lock()
+        self._epoch = 0
+        # seq -> SpmdTaskResponse, valid for the current epoch only
+        self._assignments: Dict[int, pb.SpmdTaskResponse] = {}
+
+    def _current_epoch(self) -> int:
+        if self._rendezvous is None:
+            return 0
+        return self._rendezvous.rendezvous_id
+
+    def _group_id(self, epoch: int) -> int:
+        return SPMD_GROUP_BASE + epoch
+
+    def get(self, req: pb.GetSpmdTaskRequest) -> pb.SpmdTaskResponse:
+        epoch = self._current_epoch()
+        with self._lock:
+            if epoch != self._epoch:
+                # Membership changed since the last assignment: re-queue
+                # everything the old group holds and start a new sequence.
+                recovered = self._tm.recover_tasks(self._group_id(self._epoch))
+                if recovered:
+                    logger.info(
+                        "SPMD epoch %d -> %d: recovered %d group leases",
+                        self._epoch, epoch, recovered,
+                    )
+                self._assignments.clear()
+                self._epoch = epoch
+            if req.rendezvous_id != epoch:
+                return pb.SpmdTaskResponse(epoch_stale=True)
+            cached = self._assignments.get(req.seq)
+            if cached is not None:
+                return cached
+            task = self._tm.get(self._group_id(epoch))
+            if task is not None:
+                resp = pb.SpmdTaskResponse(task=task)
+                self._assignments[req.seq] = resp
+                return resp
+            if self._tm.finished:
+                resp = pb.SpmdTaskResponse(
+                    task=pb.Task(task_id=-1, type=pb.WAIT), job_finished=True
+                )
+                self._assignments[req.seq] = resp
+                return resp
+            # Nothing leasable right now but the job isn't over (epoch
+            # rollover, eval injection pending).  NOT cached: ranks retry
+            # the same seq and the first to land after a task appears
+            # creates the shared assignment.  Task completion flows through
+            # the ordinary report_task_result RPC (rank 0 reports; the
+            # TaskManager matches leases by task_id).
+            return pb.SpmdTaskResponse(task=pb.Task(task_id=-1, type=pb.WAIT))

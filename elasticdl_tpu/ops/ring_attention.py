@@ -40,17 +40,14 @@ def _ring_attention_local(
     perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
 
     # accumulators: (B, H, Lq) softmax stats, (B, H, Lq, D) output.
-    # pvary marks them as shard-varying so the scan carry types match the
-    # per-shard loop outputs.
-    m0 = jax.lax.pvary(
-        jnp.full((batch, heads, q_len), _NEG_INF, jnp.float32), varying_axes
-    )
-    l0 = jax.lax.pvary(
-        jnp.zeros((batch, heads, q_len), jnp.float32), varying_axes
-    )
-    o0 = jax.lax.pvary(
-        jnp.zeros((batch, heads, q_len, dim), jnp.float32), varying_axes
-    )
+    # pcast-to-varying marks them as shard-varying so the scan carry
+    # types match the per-shard loop outputs.
+    def _varying(x):
+        return jax.lax.pcast(x, varying_axes, to="varying")
+
+    m0 = _varying(jnp.full((batch, heads, q_len), _NEG_INF, jnp.float32))
+    l0 = _varying(jnp.zeros((batch, heads, q_len), jnp.float32))
+    o0 = _varying(jnp.zeros((batch, heads, q_len, dim), jnp.float32))
 
     def step(carry, step_idx):
         o, m, l, k_cur, v_cur = carry

@@ -88,6 +88,26 @@ def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def make_global_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
+    """Assemble a host batch into global `jax.Array`s split along `data`.
+
+    Multi-process SPMD path: every process passes the SAME full global
+    batch (each rank reads the whole shard); `make_array_from_callback`
+    transfers only the locally-addressable shards, so no host holds or
+    ships more than its slice to devices.  Works identically in
+    single-process mode, where it degenerates to a plain sharded put.
+    """
+    sharding = data_sharding(mesh)
+
+    def to_global(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return jax.tree.map(to_global, batch)
+
+
 def pad_to_multiple(batch: Dict[str, np.ndarray], multiple: int):
     """Pad batch leading dim up to a multiple (wrapping existing rows) so
     shapes stay static under jit; returns (padded_batch, real_count)."""

@@ -21,6 +21,7 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 # method name -> (request class, response class)
 MASTER_METHODS = {
     "get_task": (pb.GetTaskRequest, pb.GetTaskResponse),
+    "get_spmd_task": (pb.GetSpmdTaskRequest, pb.SpmdTaskResponse),
     "report_task_result": (pb.ReportTaskResultRequest, pb.Empty),
     "report_evaluation_metrics": (pb.ReportEvaluationMetricsRequest, pb.Empty),
     "get_cluster_spec": (pb.GetClusterSpecRequest, pb.ClusterSpec),

@@ -59,34 +59,60 @@ def main(argv=None):
         reader = create_data_reader(args.training_data)
 
     from elasticdl_tpu.common.save_utils import CheckpointSaver
-    from elasticdl_tpu.parallel.elastic import ElasticMeshManager
     from elasticdl_tpu.worker.worker import Worker
 
-    # Cluster mode: membership epochs drive jax.distributed re-init and
-    # mesh rebuilds; checkpoints are how state survives a re-mesh on real
-    # multi-host topologies.
-    elastic = None
-    if args.distribution_strategy != "Local" and args.num_workers > 1:
-        elastic = ElasticMeshManager(
-            client, worker_id, use_jax_distributed=True
-        )
     saver = None
     if args.checkpoint_dir:
         saver = CheckpointSaver(
             args.checkpoint_dir, keep_max=args.keep_checkpoint_max
         )
 
-    worker = Worker(
-        worker_id=worker_id,
-        master_client=client,
-        data_reader=reader,
-        spec=spec,
-        minibatch_size=args.minibatch_size,
-        use_bf16=args.use_bf16,
-        elastic_manager=elastic,
-        checkpoint_saver=saver,
-        checkpoint_steps=args.checkpoint_steps,
-    )
+    if args.distribution_strategy != "Local" and args.num_workers > 1:
+        # Cluster SPMD: all worker processes form ONE global mesh and run
+        # the same collective step — there is one model by construction
+        # (worker/spmd.py).  Rank/topology comes from the master's
+        # rendezvous; wait until this worker is a member.
+        import time
+
+        from elasticdl_tpu.proto import elasticdl_pb2 as pb
+        from elasticdl_tpu.worker.spmd import SPMDWorker
+
+        while True:
+            cluster = client.get_cluster_spec(
+                pb.GetClusterSpecRequest(worker_id=worker_id)
+            )
+            me = next(
+                (w for w in cluster.workers if w.worker_id == worker_id),
+                None,
+            )
+            if me is not None and cluster.world_size == args.num_workers:
+                break
+            time.sleep(1.0)
+        worker = SPMDWorker(
+            worker_id=worker_id,
+            master_client=client,
+            data_reader=reader,
+            spec=spec,
+            minibatch_size=args.minibatch_size,
+            process_id=me.rank,
+            num_processes=cluster.world_size,
+            coordinator_address=cluster.coordinator_address,
+            use_bf16=args.use_bf16,
+            checkpoint_saver=saver,
+            checkpoint_steps=args.checkpoint_steps,
+            initial_epoch=cluster.rendezvous_id,
+        )
+    else:
+        worker = Worker(
+            worker_id=worker_id,
+            master_client=client,
+            data_reader=reader,
+            spec=spec,
+            minibatch_size=args.minibatch_size,
+            use_bf16=args.use_bf16,
+            checkpoint_saver=saver,
+            checkpoint_steps=args.checkpoint_steps,
+        )
     ok = worker.run()
     logger.info("Worker %d exiting (clean=%s)", worker_id, ok)
 

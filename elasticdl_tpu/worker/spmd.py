@@ -1,0 +1,348 @@
+"""Multi-process SPMD worker: one global mesh, one model, one train step.
+
+This is the cluster-mode replacement for BOTH reference topologies
+(SURVEY.md §3.3 PS mode, §3.4 Horovod AllReduce): instead of N workers
+training private replicas synchronized through a parameter server or an
+allreduce ring, every process joins a single `jax.distributed` runtime,
+the devices form one global `Mesh`, and all ranks enter the SAME jitted
+collective train step per global batch — XLA emits the gradient reduction
+over ICI/DCN from the shardings.  Consistency is by construction: there is
+only one logical computation, so no rank can diverge.
+
+Task flow (the part the reference's design survives intact): the master
+still owns the shard queue; ranks fetch the group-synchronized assignment
+for (epoch, seq) via get_spmd_task (master/spmd_assigner.py) so everyone
+trains the same shard in the same order.  Each rank reads the whole shard
+from shared storage and builds the full global batch host-side; only the
+locally-addressable slice is transferred to devices
+(mesh.make_global_batch).  Rank 0 alone reports task completion and
+model versions.
+
+Elasticity: a membership change bumps the rendezvous epoch; get_spmd_task
+answers `epoch_stale`, every rank tears down and re-initialises
+jax.distributed for the new topology, restores state from the latest
+checkpoint (Orbax handles cross-topology resharding) and resumes at
+seq=0 — the task queue re-leases whatever the old group held, so no
+step-exact replay is needed (SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.model_handler import ModelSpec
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+from elasticdl_tpu.worker.trainer import Trainer
+
+logger = get_logger(__name__)
+
+
+class SPMDWorker:
+    """One rank of a multi-process SPMD training job."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        master_client,
+        data_reader,
+        spec: ModelSpec,
+        minibatch_size: int = 64,  # GLOBAL batch size
+        process_id: int = 0,
+        num_processes: int = 1,
+        coordinator_address: str = "",
+        use_bf16: bool = False,
+        seed: int = 0,
+        checkpoint_saver=None,
+        checkpoint_steps: int = 0,
+        wait_sleep_s: float = 0.2,
+        initial_epoch: int = 0,
+    ):
+        self.worker_id = worker_id
+        self.spec = spec
+        self.minibatch_size = minibatch_size
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self._coordinator = coordinator_address
+        self._client = master_client
+        self._data_service = TaskDataService(
+            master_client, data_reader, worker_id
+        )
+        self._reader = data_reader
+        self._use_bf16 = use_bf16
+        self._seed = seed
+        self._saver = checkpoint_saver
+        self._checkpoint_steps = checkpoint_steps
+        self._wait_sleep_s = wait_sleep_s
+        self._epoch = initial_epoch
+        self.state = None
+        self.trainer: Optional[Trainer] = None
+        self.mesh = None
+        self.last_loss = None
+
+    # ---- runtime lifecycle --------------------------------------------
+
+    def setup(self) -> None:
+        """Join the distributed runtime and build the global mesh."""
+        if self.num_processes > 1 and not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=self._coordinator,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+        self.mesh = mesh_lib.create_mesh(jax.devices())
+        self.trainer = Trainer(
+            model=self.spec.model,
+            optimizer=self.spec.optimizer,
+            loss_fn=self.spec.loss,
+            mesh=self.mesh,
+            use_bf16=self._use_bf16,
+            param_sharding_fn=self.spec.param_sharding,
+        )
+        logger.info(
+            "SPMD rank %d/%d up: %d global devices, mesh %s",
+            self.process_id, self.num_processes,
+            len(jax.devices()), dict(self.mesh.shape),
+        )
+
+    def _ensure_state(self, batch) -> None:
+        if self.state is not None:
+            return
+        self.state = self.trainer.init_state_global(
+            jax.random.PRNGKey(self._seed), batch["features"]
+        )
+        if self._saver is not None:
+            restored = self._saver.maybe_restore(self.state)
+            if restored is not None:
+                self.state = restored
+                logger.info(
+                    "Rank %d restored checkpoint at step %d",
+                    self.process_id, int(self.state.step),
+                )
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    # ---- main loop -----------------------------------------------------
+
+    def run(self) -> bool:
+        if self.trainer is None:
+            self.setup()
+        seq = 0
+        while True:
+            try:
+                resp = self._client.get_spmd_task(
+                    pb.GetSpmdTaskRequest(
+                        worker_id=self.worker_id,
+                        rendezvous_id=self._epoch,
+                        seq=seq,
+                    )
+                )
+            except Exception as exc:
+                logger.warning("get_spmd_task failed: %s; retrying", exc)
+                time.sleep(self._wait_sleep_s)
+                continue
+            if resp.job_finished:
+                logger.info(
+                    "Job finished; SPMD rank %d exiting", self.process_id
+                )
+                return True
+            if resp.epoch_stale:
+                logger.info(
+                    "Rank %d: epoch %d stale; re-rendezvous",
+                    self.process_id, self._epoch,
+                )
+                if not self._re_rendezvous():
+                    return False
+                seq = 0
+                continue
+            task = resp.task
+            if task.task_id < 0 or task.type == pb.WAIT:
+                time.sleep(self._wait_sleep_s)
+                continue
+            self._process_task(task)
+            seq += 1
+
+    def _process_task(self, task: pb.Task) -> None:
+        # No per-rank failure reporting: if any rank's collective step
+        # dies the whole group is wedged and recovery is the elastic
+        # epoch-bump path, not a task retry.
+        if task.type == pb.TRAINING:
+            records = self._train_task(task)
+            if self.is_leader:
+                self._data_service.report_task(task, records=records)
+                try:
+                    self._client.report_version(
+                        pb.ReportVersionRequest(
+                            worker_id=self.worker_id,
+                            model_version=int(self.state.step),
+                        )
+                    )
+                except Exception:
+                    pass
+        elif task.type == pb.EVALUATION:
+            if not self._has_trained_state():
+                # Same guard as Worker._evaluate_task: never report metrics
+                # from randomly initialised params.  The condition is
+                # deterministic across ranks (state/step identical), so all
+                # ranks skip together; the leader re-queues the task.
+                if self.is_leader:
+                    self._data_service.report_task(
+                        task, err="no trained state for evaluation"
+                    )
+                return
+            records = self._evaluate_task(task)
+            if self.is_leader:
+                self._data_service.report_task(task, records=records)
+        elif task.type == pb.PREDICTION:
+            records = self._predict_task(task)
+            if self.is_leader:
+                self._data_service.report_task(task, records=records)
+        elif task.type == pb.SAVE_MODEL:
+            self._save(force=True)
+            if self.is_leader:
+                self._data_service.report_task(task, records=0)
+        else:
+            logger.warning("SPMD worker ignoring task type %s", task.type)
+            if self.is_leader:
+                self._data_service.report_task(task, records=0)
+
+    def _train_task(self, task: pb.Task) -> int:
+        records = 0
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            global_batch = mesh_lib.make_global_batch(batch, self.mesh)
+            self.state, loss = self.trainer.train_on_global_batch(
+                self.state, global_batch
+            )
+            self.last_loss = loss
+            records += real
+            self._maybe_checkpoint()
+        return records
+
+    def _evaluate_task(self, task: pb.Task) -> int:
+        records = 0
+        all_labels, all_preds = [], []
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            features = mesh_lib.make_global_batch(
+                batch["features"], self.mesh
+            )
+            preds = self.trainer.predict_on_global_batch(
+                self.state, features
+            )
+            # Data-sharded output: gather the full array onto every host
+            # so metric fns (host-side, e.g. AUC) see all rows.
+            preds = _allgather(preds)
+            all_labels.append(np.asarray(batch["labels"])[:real])
+            all_preds.append(np.asarray(preds)[:real])
+            records += real
+        if records and self.is_leader:
+            labels = np.concatenate(all_labels)
+            preds = np.concatenate(all_preds)
+            req = pb.ReportEvaluationMetricsRequest(
+                worker_id=self.worker_id,
+                model_version=task.model_version
+                if task.model_version >= 0
+                else int(self.state.step),
+                num_examples=records,
+            )
+            for name, fn in self.spec.eval_metrics.items():
+                req.metrics[name] = float(fn(labels, preds))
+            self._client.report_evaluation_metrics(req)
+        return records
+
+    def _predict_task(self, task: pb.Task) -> int:
+        records = 0
+        self.predictions = getattr(self, "predictions", [])
+        for batch, real in self._data_service.batches_for_task(
+            task, self.minibatch_size, self._feed
+        ):
+            self._ensure_state(batch)
+            features = mesh_lib.make_global_batch(
+                batch["features"], self.mesh
+            )
+            preds = _allgather(
+                self.trainer.predict_on_global_batch(self.state, features)
+            )
+            self.predictions.append(np.asarray(preds)[:real])
+            records += real
+        return records
+
+    def _has_trained_state(self) -> bool:
+        if self.state is not None and int(self.state.step) > 0:
+            return True
+        return (
+            self._saver is not None
+            and self._saver.latest_step() is not None
+        )
+
+    # ---- elasticity ----------------------------------------------------
+
+    def _re_rendezvous(self) -> bool:
+        """Membership changed: rejoin with the new topology and restore
+        state from the latest checkpoint."""
+        spec = self._client.get_cluster_spec(
+            pb.GetClusterSpecRequest(
+                worker_id=self.worker_id, known_rendezvous_id=self._epoch
+            )
+        )
+        me = next(
+            (w for w in spec.workers if w.worker_id == self.worker_id), None
+        )
+        if me is None or spec.world_size == 0:
+            logger.warning(
+                "Worker %d evicted at epoch %d; exiting",
+                self.worker_id, spec.rendezvous_id,
+            )
+            return False
+        self._epoch = spec.rendezvous_id
+        if jax.distributed.is_initialized():
+            jax.distributed.shutdown()
+        self.process_id = me.rank
+        self.num_processes = spec.world_size
+        self._coordinator = spec.coordinator_address or self._coordinator
+        self.state = None  # re-init + checkpoint restore on next batch
+        self.setup()
+        return True
+
+    # ---- helpers -------------------------------------------------------
+
+    def _save(self, force: bool = False) -> None:
+        # Orbax distributed save: EVERY rank participates (each writes its
+        # addressable shards); the decision is deterministic on step so all
+        # ranks enter together.
+        if self._saver is not None and self.state is not None:
+            self._saver.save(self.state, force=force)
+
+    def _maybe_checkpoint(self) -> None:
+        if (
+            self._saver is not None
+            and self._checkpoint_steps
+            and int(self.state.step) % self._checkpoint_steps == 0
+        ):
+            self._saver.save(self.state)
+
+    def _feed(self, records):
+        return self.spec.feed(records, getattr(self._reader, "metadata", {}))
+
+
+def _allgather(x):
+    """Full-array gather onto every host (jax multihost utils; no-op in
+    single-process mode)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)

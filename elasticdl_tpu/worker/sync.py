@@ -1,0 +1,121 @@
+"""Model ownership and intra-process synchronization.
+
+The reference kept every worker on ONE shared model: PS mode served all
+workers from one parameter store (SURVEY.md C10, call stack §3.3);
+AllReduce mode kept replicas in lockstep via Horovod (C15, §3.4).  The
+TPU-native analogue inside one process is a single `ModelOwner`: one
+Trainer + one TrainState shared by every worker thread, updates serialized
+under a lock.  Semantically this is the reference's *async PS* — each
+worker computes gradients against the params as of its own step start, and
+applies them atomically — with staleness bounded by the number of threads
+instead of by network latency.
+
+Cross-process synchronization (cluster mode) is NOT this file's job: that
+is SPMD over a global mesh (worker/spmd.py), where consistency holds by
+construction because every process executes the same collective step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class ModelOwner:
+    """Owns one model replica: trainer + state + update lock + checkpoints.
+
+    Workers never touch TrainState directly; everything flows through the
+    owner so N workers sharing one owner train one model (the property the
+    reference's whole PS/AllReduce machinery exists to provide).
+    """
+
+    def __init__(
+        self,
+        trainer,
+        seed: int = 0,
+        checkpoint_saver=None,
+        checkpoint_steps: int = 0,
+    ):
+        self.trainer = trainer
+        self.lock = threading.RLock()
+        self.state = None
+        self._rng = jax.random.PRNGKey(seed)
+        self.checkpoint_saver = checkpoint_saver
+        self.checkpoint_steps = checkpoint_steps
+
+    # ---- state lifecycle ----------------------------------------------
+
+    def ensure_state(self, batch) -> None:
+        with self.lock:
+            if self.state is not None:
+                return
+            self.state = self.trainer.init_state(
+                self._rng, batch["features"]
+            )
+            if self.checkpoint_saver is not None:
+                restored = self.checkpoint_saver.maybe_restore(self.state)
+                if restored is not None:
+                    self.state = restored
+                    logger.info("Restored state from checkpoint")
+
+    def has_trained_state(self) -> bool:
+        """True if the owner holds (or can restore) non-random params."""
+        with self.lock:
+            if self.state is not None and int(self.state.step) > 0:
+                return True
+            return (
+                self.checkpoint_saver is not None
+                and self.checkpoint_saver.latest_step() is not None
+            )
+
+    @property
+    def step(self) -> int:
+        with self.lock:
+            return 0 if self.state is None else int(self.state.step)
+
+    # ---- serialized model operations ----------------------------------
+
+    def train_batch(self, batch):
+        with self.lock:
+            self.ensure_state(batch)
+            self.state, loss = self.trainer.train_on_batch(
+                self.state, batch
+            )
+            self._maybe_checkpoint()
+            return loss
+
+    def predict_batch(self, batch):
+        with self.lock:
+            self.ensure_state(batch)
+            return self.trainer.predict_on_batch(
+                self.state, batch["features"]
+            )
+
+    def save(self, force: bool = False) -> None:
+        with self.lock:
+            if self.checkpoint_saver is not None and self.state is not None:
+                self.checkpoint_saver.save(self.state, force=force)
+
+    def _maybe_checkpoint(self) -> None:
+        if (
+            self.checkpoint_saver is not None
+            and self.checkpoint_steps
+            and self.state is not None
+            and int(self.state.step) % self.checkpoint_steps == 0
+        ):
+            self.checkpoint_saver.save(self.state)
+
+    # ---- elastic re-mesh ----------------------------------------------
+
+    def remesh(self, mesh) -> None:
+        """Point the trainer at a new mesh and re-place existing state."""
+        with self.lock:
+            self.trainer.set_mesh(mesh)
+            if self.state is not None:
+                self.state = self.trainer.replace_state(self.state)

@@ -114,6 +114,33 @@ class Trainer:
         )
         return jax.device_put(state, self.state_sharding(state))
 
+    def init_state_global(self, rng, sample_features) -> TrainState:
+        """Multi-process SPMD init: the whole init (model.init + optimizer
+        init) runs as ONE jitted program with `out_shardings` over the
+        global mesh, so every process participates in the same computation
+        and the resulting state is identical across ranks by construction
+        (no host-side broadcast needed — the reference's AllReduce mode had
+        to broadcast variables from rank 0 instead, SURVEY.md §3.4)."""
+        mesh_lib.set_current_mesh(self.mesh)
+        kwargs = {"train": False} if self._has_train_kwarg else {}
+        features = jax.tree.map(np.asarray, sample_features)
+
+        def make():
+            variables = dict(
+                self.model.init(rng, self._cast(features), **kwargs)
+            )
+            params = {"params": variables.pop("params")}
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.optimizer.init(params),
+                model_state=variables,
+            )
+
+        shapes = jax.eval_shape(make)
+        shardings = self.state_sharding(shapes)
+        return jax.jit(make, out_shardings=shardings)()
+
     def state_sharding(self, state):
         """Sharding tree for the train state: replicated by default;
         `param_sharding_fn(path, value) -> PartitionSpec` overrides (used
@@ -228,6 +255,18 @@ class Trainer:
         batch = mesh_lib.shard_batch(batch, self.mesh)
         state, loss = self.train_step(state, batch)
         return state, loss
+
+    def train_on_global_batch(self, state, global_batch):
+        """Train step on a batch already assembled into global arrays
+        (mesh.make_global_batch) — the multi-process SPMD hot path."""
+        mesh_lib.set_current_mesh(self.mesh)
+        return self.train_step(state, global_batch)
+
+    def predict_on_global_batch(self, state, global_features):
+        """Forward pass on global arrays; returns the still-global (data-
+        sharded) predictions — callers allgather if they need host values."""
+        mesh_lib.set_current_mesh(self.mesh)
+        return self.eval_step(state, global_features)
 
     def predict_on_batch(self, state, features):
         mesh_lib.set_current_mesh(self.mesh)

@@ -5,6 +5,11 @@ Differences by design: the hot loop is an XLA-compiled step on the device
 mesh instead of eager ops + per-step PS RPCs — the only RPCs left are
 per-*shard* get_task/report (the property that kept master load low in the
 reference is preserved exactly).
+
+Model state lives in a `ModelOwner` (worker/sync.py).  Workers sharing one
+owner train ONE model — the multi-worker consistency the reference provided
+via PS/Horovod; a worker given no owner builds a private one (single-worker
+jobs, tests).
 """
 
 from __future__ import annotations
@@ -12,12 +17,12 @@ from __future__ import annotations
 import traceback
 from typing import Dict, Optional
 
-import jax
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_handler import ModelSpec
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.sync import ModelOwner
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import Trainer
 
@@ -38,6 +43,7 @@ class Worker:
         checkpoint_saver=None,
         checkpoint_steps: int = 0,
         elastic_manager=None,
+        model_owner: Optional[ModelOwner] = None,
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -46,19 +52,33 @@ class Worker:
         self._data_service = TaskDataService(
             master_client, data_reader, worker_id
         )
-        self.trainer = Trainer(
-            model=spec.model,
-            optimizer=spec.optimizer,
-            loss_fn=spec.loss,
-            mesh=mesh,
-            use_bf16=use_bf16,
-            param_sharding_fn=spec.param_sharding,
-        )
-        self._rng = jax.random.PRNGKey(seed)
-        self.state = None
+        if model_owner is not None and (
+            mesh is not None
+            or use_bf16
+            or seed != 0
+            or checkpoint_saver is not None
+            or checkpoint_steps != 0
+        ):
+            raise ValueError(
+                "mesh/use_bf16/seed/checkpoint_* are owned by the "
+                "ModelOwner; configure them on the owner you pass in"
+            )
+        if model_owner is None:
+            model_owner = ModelOwner(
+                Trainer(
+                    model=spec.model,
+                    optimizer=spec.optimizer,
+                    loss_fn=spec.loss,
+                    mesh=mesh,
+                    use_bf16=use_bf16,
+                    param_sharding_fn=spec.param_sharding,
+                ),
+                seed=seed,
+                checkpoint_saver=checkpoint_saver,
+                checkpoint_steps=checkpoint_steps,
+            )
+        self._owner = model_owner
         self._reader = data_reader
-        self._checkpoint_saver = checkpoint_saver
-        self._checkpoint_steps = checkpoint_steps
         # Bounded: device arrays, converted lazily; unbounded growth would
         # pin one device buffer per step for the job's lifetime.
         from collections import deque
@@ -66,18 +86,23 @@ class Worker:
         self.losses = deque(maxlen=1024)
         self._elastic = elastic_manager
 
-    # ---- init ----------------------------------------------------------
+    # ---- owner passthroughs (tests and the client API read these) ------
 
-    def _ensure_state(self, batch: Dict[str, np.ndarray]):
-        if self.state is None:
-            self.state = self.trainer.init_state(
-                self._rng, batch["features"]
-            )
-            if self._checkpoint_saver is not None:
-                restored = self._checkpoint_saver.maybe_restore(self.state)
-                if restored is not None:
-                    self.state = restored
-                    logger.info("Restored state from checkpoint")
+    @property
+    def state(self):
+        return self._owner.state
+
+    @property
+    def trainer(self):
+        return self._owner.trainer
+
+    @property
+    def model_owner(self) -> ModelOwner:
+        return self._owner
+
+    @property
+    def _checkpoint_saver(self):
+        return self._owner.checkpoint_saver
 
     # ---- loops ---------------------------------------------------------
 
@@ -93,12 +118,12 @@ class Worker:
             try:
                 records = self._process_task(task)
                 self._data_service.report_task(task, records=records)
-                if task.type == pb.TRAINING and self.state is not None:
+                if task.type == pb.TRAINING:
                     try:
                         self._client.report_version(
                             pb.ReportVersionRequest(
                                 worker_id=self.worker_id,
-                                model_version=int(self.state.step),
+                                model_version=self._owner.step,
                             )
                         )
                     except Exception:
@@ -122,7 +147,7 @@ class Worker:
         if task.type == pb.PREDICTION:
             return self._predict_task(task)
         if task.type == pb.SAVE_MODEL:
-            self._save_model(task)
+            self._owner.save(force=True)
             return 0
         logger.warning("Unknown task type %s", task.type)
         return 0
@@ -132,21 +157,20 @@ class Worker:
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
-            self._ensure_state(batch)
-            self.state, loss = self.trainer.train_on_batch(self.state, batch)
+            loss = self._owner.train_batch(batch)
             records += real
             self.losses.append(loss)
-            self._maybe_checkpoint()
         return records
 
     def _evaluate_task(self, task: pb.Task) -> int:
         """Forward-only over the shard; metrics computed host-side on the
         un-padded slice and reported to the master for aggregation."""
-        if self.state is None and self._checkpoint_saver is None:
-            # A fresh worker (e.g. a replacement pod) must not report
-            # metrics from randomly initialised params.  Re-queue the task
-            # for a worker with trained state (or let checkpoint restore
-            # below provide one).
+        if not self._owner.has_trained_state():
+            # A fresh worker (e.g. a replacement pod) with no trained state
+            # and no checkpoint to restore must not report metrics from
+            # randomly initialised params.  Re-queue for a worker that has
+            # either.  (ADVICE r1: a configured-but-empty checkpoint dir
+            # counts as *no* trained state.)
             raise RuntimeError(
                 "worker has no trained state for evaluation; re-queueing"
             )
@@ -155,10 +179,7 @@ class Worker:
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
-            self._ensure_state(batch)
-            preds = self.trainer.predict_on_batch(
-                self.state, batch["features"]
-            )
+            preds = self._owner.predict_batch(batch)
             all_labels.append(np.asarray(batch["labels"])[:real])
             all_preds.append(preds[:real])
             records += real
@@ -171,7 +192,7 @@ class Worker:
                 worker_id=self.worker_id,
                 model_version=task.model_version
                 if task.model_version >= 0
-                else int(self.state.step) if self.state is not None else 0,
+                else self._owner.step,
                 num_examples=records,
             )
             for name, fn in self.spec.eval_metrics.items():
@@ -185,26 +206,10 @@ class Worker:
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
-            self._ensure_state(batch)
-            preds = self.trainer.predict_on_batch(
-                self.state, batch["features"]
-            )
+            preds = self._owner.predict_batch(batch)
             self.predictions.append(preds[:real])
             records += real
         return records
-
-    def _save_model(self, task: pb.Task):
-        if self._checkpoint_saver is not None and self.state is not None:
-            self._checkpoint_saver.save(self.state, force=True)
-
-    def _maybe_checkpoint(self):
-        if (
-            self._checkpoint_saver is not None
-            and self._checkpoint_steps
-            and self.state is not None
-            and int(self.state.step) % self._checkpoint_steps == 0
-        ):
-            self._checkpoint_saver.save(self.state)
 
     def _maybe_remesh(self):
         """Elastic cycle: if the membership epoch moved, rebuild the mesh
@@ -217,10 +222,7 @@ class Worker:
         mesh = self._elastic.build_mesh(spec)
         if mesh is None:
             return
-        self.trainer.set_mesh(mesh)
-        if self.state is not None:
-            self.state = self.trainer.replace_state(self.state)
-        # else: state placed on the new mesh by _ensure_state on first batch
+        self._owner.remesh(mesh)
 
     def _feed(self, records):
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))

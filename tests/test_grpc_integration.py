@@ -44,16 +44,30 @@ def test_full_job_over_grpc_with_two_workers(mnist_data, spec):
     port = master.start_grpc(port=0)
     addr = f"127.0.0.1:{port}"
 
+    # ONE shared model for both workers (the reference's PS/AllReduce
+    # consistency property): every task's gradients update the same params.
+    from elasticdl_tpu.worker.sync import ModelOwner
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    owner = ModelOwner(
+        Trainer(model=spec.model, optimizer=spec.optimizer,
+                loss_fn=spec.loss)
+    )
+    workers = []
+
     def run_worker(worker_id):
         stub = MasterStub(grpc.insecure_channel(addr))
         reader = TFRecordDataReader(train_dir)
-        Worker(
+        worker = Worker(
             worker_id=worker_id,
             master_client=stub,
             data_reader=reader,
             spec=spec,
             minibatch_size=32,
-        ).run()
+            model_owner=owner,
+        )
+        workers.append(worker)
+        worker.run()
 
     threads = [
         threading.Thread(target=run_worker, args=(i,)) for i in range(2)
@@ -65,6 +79,11 @@ def test_full_job_over_grpc_with_two_workers(mnist_data, spec):
         t.join(timeout=30)
     assert master.task_manager.finished
     assert master.task_manager.counters.records_done >= 256
+    # End-state parity: the final model saw ALL the data — its step count
+    # equals the total number of training batches across BOTH workers
+    # (diverging replicas would each hold only their own share of steps).
+    assert int(owner.state.step) == 256 // 32
+    assert all(w.model_owner is owner for w in workers)
     # final evaluation ran and aggregated
     metrics = master.evaluation_service.latest_metrics()
     assert metrics is not None and "accuracy" in metrics
