@@ -181,14 +181,26 @@ def _submit_master_pod(args, job_type: str) -> int:
         + ["--job_type", job_type]
     )
     client = K8sClient(namespace=args.namespace, job_name=args.job_name)
+    master_name = f"{args.job_name}-master"
     client.create_pod(
         PodSpec(
-            name=f"{args.job_name}-master",
+            name=master_name,
             pod_type=PodType.MASTER,
             image=args.image_name,
             command=command,
             resources={},
         )
+    )
+    # Worker pods dial `{job_name}-master:{port}`; that DNS name only
+    # exists if a Service fronts the master pod (selector = the labels
+    # K8sClient.create_pod stamps on it).
+    client.create_service(
+        master_name,
+        selector={
+            "elasticdl-job": args.job_name,
+            "elasticdl-type": PodType.MASTER,
+        },
+        port=args.port,
     )
     logger.info(
         "Submitted master pod %s-master to namespace %s",

@@ -64,6 +64,12 @@ def add_common_params(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--need_tf_config", type=str2bool, default=False, nargs="?", const=True
     )
+    parser.add_argument(
+        "--use_fake_k8s", type=str2bool, default=False,
+        help="Use the in-memory fake cluster instead of the Kubernetes API "
+        "(dev/test: exercises the full elastic control plane with no "
+        "cluster)",
+    )
 
 
 def add_model_params(parser: argparse.ArgumentParser):

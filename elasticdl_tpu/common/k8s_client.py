@@ -43,6 +43,14 @@ class AbstractK8sClient:
     def create_pod(self, spec: PodSpec) -> None:
         raise NotImplementedError
 
+    def create_service(
+        self, name: str, selector: Dict[str, str], port: int
+    ) -> None:
+        """Expose pods matching `selector` at DNS name `name`:`port` —
+        worker pods reach the master via `{job_name}-master:{port}`, which
+        only resolves if a Service fronts the master pod."""
+        raise NotImplementedError
+
     def delete_pod(self, name: str) -> None:
         raise NotImplementedError
 
@@ -74,6 +82,13 @@ class FakeK8sClient(AbstractK8sClient):
         with self._lock:
             self.phases[spec.name] = PodStatus.RUNNING
         self._emit(spec.name, PodStatus.RUNNING)
+
+    def create_service(
+        self, name: str, selector: Dict[str, str], port: int
+    ) -> None:
+        with self._lock:
+            self.services = getattr(self, "services", {})
+            self.services[name] = {"selector": selector, "port": port}
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
@@ -155,6 +170,21 @@ class K8sClient(AbstractK8sClient):
             ),
         )
         self._core.create_namespaced_pod(self._namespace, pod)
+
+    def create_service(
+        self, name: str, selector: Dict[str, str], port: int
+    ) -> None:
+        client = self._client_mod
+        service = client.V1Service(
+            metadata=client.V1ObjectMeta(
+                name=name, labels={"elasticdl-job": self._job_name}
+            ),
+            spec=client.V1ServiceSpec(
+                selector=selector,
+                ports=[client.V1ServicePort(port=port, target_port=port)],
+            ),
+        )
+        self._core.create_namespaced_service(self._namespace, service)
 
     def delete_pod(self, name: str) -> None:
         self._core.delete_namespaced_pod(name, self._namespace)

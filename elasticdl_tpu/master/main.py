@@ -22,14 +22,26 @@ from elasticdl_tpu.master.task_manager import (
     TaskManager,
     create_shards_from_ranges,
 )
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger(__name__)
 
 
 class Master:
-    """Owns the control plane of one job."""
+    """Owns the control plane of one job.
 
-    def __init__(self, args, data_reader=None, validation_reader=None):
+    Cluster-elastic mode (SURVEY.md §3.2) engages when a k8s client is
+    passed: the master constructs the RendezvousServer (membership epochs)
+    and PodManager (create/watch/relaunch worker pods), generates worker
+    pod commands by re-serializing its own args (argv is the config wire
+    format, as in the reference), and injects a SAVE_MODEL task at job end
+    so a worker exports the final model.  With `k8s_client=None` the
+    master is control-plane-only (Local mode, unit tests).
+    """
+
+    def __init__(
+        self, args, data_reader=None, validation_reader=None, k8s_client=None
+    ):
         self.args = args
         self.job_type = getattr(args, "job_type", "train")
         self._reader = data_reader
@@ -82,8 +94,30 @@ class Master:
             start_delay_secs=args.evaluation_start_delay_secs,
             throttle_secs=args.evaluation_throttle_secs,
         )
-        self.rendezvous_server = None  # attached in elastic mode (M5)
+        self.rendezvous_server = None
         self.pod_manager = None
+        if k8s_client is not None:
+            from elasticdl_tpu.master.pod_manager import PodManager
+            from elasticdl_tpu.master.rendezvous_server import RendezvousServer
+
+            self.rendezvous_server = RendezvousServer()
+            self.pod_manager = PodManager(
+                k8s_client,
+                task_manager=self.task_manager,
+                rendezvous_server=self.rendezvous_server,
+                job_name=args.job_name,
+                num_workers=args.num_workers,
+                image=getattr(args, "image_name", ""),
+                worker_command=self._worker_command,
+                relaunch_on_worker_failure=getattr(
+                    args, "relaunch_on_worker_failure", 3
+                ),
+                worker_resources=_parse_resources(
+                    getattr(args, "worker_resource_request", "")
+                ),
+                priority_class=getattr(args, "worker_pod_priority", ""),
+                on_job_abort=self._on_job_abort,
+            )
         self.servicer = MasterServicer(
             self.task_manager,
             evaluation_service=self.evaluation_service,
@@ -91,6 +125,8 @@ class Master:
         )
         self._grpc_server = None
         self._done = threading.Event()
+        self._aborted: Optional[str] = None
+        self.bound_port: Optional[int] = None
         self.task_manager.add_all_done_callback(self._on_all_done)
         # Final evaluation over the validation set: injected atomically by
         # the task manager the moment the queue first drains (no window in
@@ -99,8 +135,55 @@ class Master:
         self._evaluation_shards = evaluation_shards
         if evaluation_shards and self.job_type == "train":
             self.task_manager.add_pre_finish_provider(self._final_eval_tasks)
+        # Cluster mode: final export rides the task queue — ONE SAVE_MODEL
+        # task with the output dir in its config rider is injected when the
+        # queue drains (after the final eval round; providers run in
+        # registration order); the leasing worker exports.
+        self._save_model_done = False
+        if (
+            self.pod_manager is not None
+            and self.job_type == "train"
+            and getattr(args, "output", "")
+        ):
+            self.task_manager.add_pre_finish_provider(self._save_model_tasks)
+
+    def _save_model_tasks(self):
+        if self._save_model_done:
+            return []
+        self._save_model_done = True
+        import json
+
+        rider = json.dumps({"output": self.args.output})
+        return [(pb.Shard(), pb.SAVE_MODEL, -1, rider)]
 
     # ---- lifecycle -----------------------------------------------------
+
+    def _worker_command(self, worker_id: int):
+        """Worker pod command: this master's args re-serialized as argv
+        plus the worker's identity and the master's address (the reference
+        passed these through env + argv the same way — SURVEY.md C21)."""
+        worker_args = args_lib.build_arguments_from_parsed_result(
+            self.args,
+            filter_args={"job_type", "worker_id", "master_addr", "func"},
+        )
+        port = self.bound_port if self.bound_port else self.args.port
+        return (
+            ["python", "-m", "elasticdl_tpu.worker.main"]
+            + worker_args
+            + [
+                "--master_addr",
+                f"{self.args.job_name}-master:{port}",
+                "--worker_id", str(worker_id),
+                "--job_type", self.job_type,
+            ]
+        )
+
+    def start(self, port: Optional[int] = None) -> int:
+        """Serve gRPC, then (cluster mode) create the worker pods."""
+        actual = self.start_grpc(port)
+        if self.pod_manager is not None:
+            self.pod_manager.start()
+        return actual
 
     def start_grpc(self, port: Optional[int] = None) -> int:
         import grpc
@@ -117,6 +200,7 @@ class Master:
         add_master_servicer_to_server(self.servicer, self._grpc_server)
         bind = f"[::]:{port if port is not None else self.args.port}"
         actual = self._grpc_server.add_insecure_port(bind)
+        self.bound_port = actual
         self._grpc_server.start()
         logger.info("Master gRPC serving on %s", actual)
         self.task_manager.start_lease_reaper()
@@ -143,6 +227,11 @@ class Master:
     def _on_all_done(self):
         self._done.set()
 
+    def _on_job_abort(self, reason: str):
+        logger.error("Job aborted: %s", reason)
+        self._aborted = reason
+        self._done.set()
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         deadline = None if timeout is None else time.time() + timeout
         while True:
@@ -150,27 +239,57 @@ class Master:
             if remaining is not None and remaining <= 0:
                 return False
             if self._done.wait(timeout=0.2 if remaining is None else min(0.2, remaining)):
+                if self._aborted is not None:
+                    return False
                 if self.task_manager.finished:
                     return True
 
     def stop(self):
+        if self.pod_manager is not None:
+            self.pod_manager.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1)
 
 
-def main(argv=None):
+def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
+    """Master process entry point.  In cluster strategies this constructs
+    the full elastic stack (rendezvous + pod manager over a real — or with
+    --use_fake_k8s an in-memory — Kubernetes client); tests may inject
+    `k8s_client` directly."""
     args = args_lib.parse_master_args(argv)
-    master = Master(args)
-    master.start_grpc()
-    master.wait()
+    if k8s_client is None and args.distribution_strategy != "Local":
+        if args.use_fake_k8s:
+            from elasticdl_tpu.common.k8s_client import FakeK8sClient
+
+            k8s_client = FakeK8sClient()
+        else:
+            from elasticdl_tpu.common.k8s_client import K8sClient
+
+            k8s_client = K8sClient(
+                namespace=args.namespace, job_name=args.job_name
+            )
+    master = Master(args, k8s_client=k8s_client)
+    master.start()
+    ok = master.wait()
     logger.info("Job complete: %s", master.task_manager.snapshot())
     metrics = master.evaluation_service.latest_metrics()
     if metrics:
         logger.info("Final metrics: %s", metrics)
     # Linger so workers polling get_task observe job_finished and exit
     # cleanly instead of hitting a torn-down server mid-RPC.
-    time.sleep(5.0)
+    time.sleep(linger_s)
     master.stop()
+    return 0 if ok else 1
+
+
+def _parse_resources(spec: str):
+    """'cpu=1,memory=4096Mi' -> {'cpu': '1', 'memory': '4096Mi'}"""
+    out = {}
+    for part in (spec or "").split(","):
+        if "=" in part:
+            key, value = part.split("=", 1)
+            out[key.strip()] = value.strip()
+    return out
 
 
 if __name__ == "__main__":

@@ -33,6 +33,7 @@ class PodManager:
         relaunch_on_worker_failure: int = 3,
         worker_resources: Optional[Dict[str, str]] = None,
         priority_class: str = "",
+        on_job_abort=None,
     ):
         self._k8s = k8s_client
         self._tm = task_manager
@@ -44,6 +45,9 @@ class PodManager:
         self._relaunch_budget = relaunch_on_worker_failure
         self._resources = worker_resources or {}
         self._priority_class = priority_class
+        # Fired when the last worker dies with its relaunch chain exhausted
+        # — without it a fully-crashed job would hang the master forever.
+        self._on_job_abort = on_job_abort or (lambda reason: None)
 
         self._lock = threading.Lock()
         self._next_worker_id = 0
@@ -87,9 +91,7 @@ class PodManager:
             if worker_id is None:
                 worker_id = self._next_worker_id
                 self._next_worker_id += 1
-            pod_name = f"{self._job_name}-worker-{worker_id}"
-            self._pod_by_worker[worker_id] = pod_name
-            self._worker_by_pod[pod_name] = worker_id
+            pod_name = self._register_worker_locked(worker_id)
         spec = PodSpec(
             name=pod_name,
             pod_type=PodType.WORKER,
@@ -102,6 +104,12 @@ class PodManager:
         logger.info("Launching %s", pod_name)
         self._k8s.create_pod(spec)
         return worker_id
+
+    def _register_worker_locked(self, worker_id: int) -> str:
+        pod_name = f"{self._job_name}-worker-{worker_id}"
+        self._pod_by_worker[worker_id] = pod_name
+        self._worker_by_pod[pod_name] = worker_id
+        return pod_name
 
     # ---- event handling ------------------------------------------------
 
@@ -138,7 +146,9 @@ class PodManager:
         # The budget is tracked per replacement CHAIN: a replacement pod
         # inherits the failure count of the worker it replaces, so a
         # crash-looping worker fails the chain after `budget` relaunches
-        # instead of looping forever under fresh ids.
+        # instead of looping forever under fresh ids.  Id allocation and
+        # chain-count update happen in ONE critical section so two
+        # near-simultaneous failures cannot under-count the chain.
         if self.stopped or phase == PodStatus.DELETED:
             return
         with self._lock:
@@ -148,11 +158,21 @@ class PodManager:
                     "Worker %d exhausted relaunch budget (%d)",
                     worker_id, self._relaunch_budget,
                 )
-                return
-        # New worker id (reference behavior: replacement pods get fresh ids)
-        new_id = self._launch_worker()
-        with self._lock:
-            self._relaunch_count[new_id] = count + 1
+                new_id = None
+                none_alive = not self._pod_by_worker
+            else:
+                # New worker id (reference: replacements get fresh ids);
+                # id allocation + chain count in one critical section.
+                new_id = self._next_worker_id
+                self._next_worker_id += 1
+                self._relaunch_count[new_id] = count + 1
+        if new_id is not None:
+            self._launch_worker(new_id)
+        elif none_alive:
+            self._on_job_abort(
+                f"all workers dead; worker {worker_id} exhausted its "
+                f"relaunch budget ({self._relaunch_budget})"
+            )
 
     # ---- introspection -------------------------------------------------
 

@@ -23,14 +23,12 @@ class MasterServicer:
         task_manager: TaskManager,
         evaluation_service=None,
         rendezvous_server=None,
-        pod_manager=None,
     ):
         from elasticdl_tpu.master.spmd_assigner import SpmdAssigner
 
         self._tm = task_manager
         self._eval = evaluation_service
         self._rendezvous = rendezvous_server
-        self._pod_manager = pod_manager
         self._spmd = SpmdAssigner(task_manager, rendezvous_server)
         self._worker_liveness = {}
         self._max_model_version = 0

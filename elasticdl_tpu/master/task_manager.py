@@ -288,7 +288,8 @@ class TaskManager:
         self._all_done_callbacks.append(cb)
 
     def add_pre_finish_provider(self, provider: Callable[[], list]):
-        """provider() -> list of (shard, task_type, model_version) tuples to
+        """provider() -> list of (shard, task_type, model_version) or
+        (shard, task_type, model_version, extended_config) tuples to
         inject when the queue first drains; called under the task-manager
         lock, so it must not call back into this TaskManager."""
         self._pre_finish_providers.append(provider)
@@ -305,9 +306,14 @@ class TaskManager:
             return False
         for provider in self._pre_finish_providers:
             injected = False
-            for shard, task_type, model_version in provider():
+            for entry in provider():
+                shard, task_type, model_version = entry[:3]
+                extended = entry[3] if len(entry) > 3 else ""
                 self._todo.appendleft(
-                    self._new_task(shard, task_type, model_version)
+                    self._new_task(
+                        shard, task_type, model_version,
+                        extended_config=extended,
+                    )
                 )
                 injected = True
             if injected:

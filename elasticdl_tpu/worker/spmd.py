@@ -208,7 +208,18 @@ class SPMDWorker:
         elif task.type == pb.SAVE_MODEL:
             self._save(force=True)
             if self.is_leader:
-                self._data_service.report_task(task, records=0)
+                from elasticdl_tpu.worker.worker import export_for_task
+
+                # Params are replicated => fully addressable on every
+                # host; the leader alone writes the export.  No trained
+                # state (deterministic across ranks) => report failure so
+                # the task re-queues instead of silently skipping.
+                try:
+                    export_for_task(self.state, self.spec, task)
+                except RuntimeError as exc:
+                    self._data_service.report_task(task, err=str(exc))
+                else:
+                    self._data_service.report_task(task, records=0)
         else:
             logger.warning("SPMD worker ignoring task type %s", task.type)
             if self.is_leader:

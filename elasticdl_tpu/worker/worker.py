@@ -147,10 +147,16 @@ class Worker:
         if task.type == pb.PREDICTION:
             return self._predict_task(task)
         if task.type == pb.SAVE_MODEL:
-            self._owner.save(force=True)
+            self._save_model(task)
             return 0
         logger.warning("Unknown task type %s", task.type)
         return 0
+
+    def _save_model(self, task: pb.Task):
+        """Checkpoint, and export if the task's config rider asks for it
+        (cluster mode: the master injects the output dir at job end)."""
+        self._owner.save(force=True)
+        export_for_task(self._owner.state, self.spec, task)
 
     def _train_task(self, task: pb.Task) -> int:
         records = 0
@@ -226,3 +232,41 @@ class Worker:
 
     def _feed(self, records):
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))
+
+
+def _task_output_dir(task: pb.Task) -> str:
+    """Extract the export dir from a SAVE_MODEL task's JSON config rider."""
+    if not task.extended_config:
+        return ""
+    import json
+
+    try:
+        return json.loads(task.extended_config).get("output", "")
+    except ValueError:
+        logger.warning(
+            "Bad extended_config on task %d: %r",
+            task.task_id, task.extended_config,
+        )
+        return ""
+
+
+def export_for_task(state, spec, task: pb.Task) -> bool:
+    """Export the model if the SAVE_MODEL task's rider names an output dir.
+
+    Raises when an export was requested but there is no trained state —
+    a silent skip would let the job report success with args.output never
+    written; raising re-queues the task for a worker that has state.
+    """
+    output = _task_output_dir(task)
+    if not output:
+        return False
+    if state is None:
+        raise RuntimeError(
+            "SAVE_MODEL requested an export but this worker has no "
+            "trained state; re-queueing"
+        )
+    from elasticdl_tpu.common.export import export_model
+
+    export_model(state, spec, output)
+    logger.info("Exported model to %s", output)
+    return True
