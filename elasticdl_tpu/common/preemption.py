@@ -1,0 +1,48 @@
+"""Save-on-preemption: checkpoint before the pod dies.
+
+Parity: reference §3.6/SURVEY.md §5 — the reference checkpointed PS state
+on signal.  On preemptible TPU VMs the kernel delivers SIGTERM with a
+grace window before the VM is reclaimed; the hook flushes one final
+(synchronous) checkpoint so the replacement topology restores from the
+last step instead of the last periodic save.  Elastic recovery then
+proceeds through the normal epoch-bump path — the task queue re-leases
+whatever this worker held.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Callable, Iterable
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+def install_preemption_hook(
+    save_fn: Callable[[], None],
+    signals: Iterable[int] = (signal.SIGTERM,),
+    exit_after: bool = True,
+    exit_code: int = 143,
+) -> Callable[[int, object], None]:
+    """Register `save_fn` to run on preemption signals.
+
+    exit_after=False is for tests (the handler returns instead of
+    exiting).  Returns the handler so tests can invoke it directly.
+    """
+
+    def handler(signum, frame):
+        logger.warning(
+            "Preemption signal %d: flushing final checkpoint", signum
+        )
+        try:
+            save_fn()
+        except Exception as exc:  # best effort — never mask the shutdown
+            logger.error("Preemption checkpoint failed: %s", exc)
+        if exit_after:
+            sys.exit(exit_code)
+
+    for sig in signals:
+        signal.signal(sig, handler)
+    return handler

@@ -51,6 +51,32 @@ class CheckpointSaver:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
+    def all_steps(self):
+        return list(self._mngr.all_steps())
+
+    def restore_step(self, step: int, template: Any) -> Optional[Any]:
+        """Restore a SPECIFIC checkpointed step into `template`'s
+        shardings (eval-at-version: score the model the master asked
+        about, not whatever the leasing worker currently holds)."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        if step not in self._mngr.all_steps():
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if hasattr(x, "shape")
+            else x,
+            template,
+        )
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        logger.info("Restored checkpoint step %d (eval-at-version)", step)
+        return restored
+
     def maybe_restore(self, template: Any) -> Optional[Any]:
         """Restore the newest checkpoint into the sharding/structure of
         `template` (an abstract or concrete train state)."""

@@ -59,6 +59,7 @@ class MasterServicer:
             success=(req.err_message == ""),
             worker_id=req.worker_id,
             records=req.exec_counters.get("records", 0),
+            transient=req.transient,
         )
         return pb.Empty()
 

@@ -104,6 +104,7 @@ class TaskManager:
         # job can finish once its eval/predict tasks drain.
         self._epoch = 0 if training_shards else num_epochs
         self._task_retry_count: Dict[int, int] = {}
+        self._transient_count: Dict[int, int] = {}
         self.counters = TaskCounters()
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
@@ -196,15 +197,25 @@ class TaskManager:
                 and self._training_shards
             ):
                 self._create_training_tasks_locked()
-                task = self._todo.popleft() if self._todo else None
+                # Epoch refills produce TRAINING tasks only — honor an
+                # explicit type filter instead of handing the caller the
+                # queue head regardless (ADVICE r1).
+                if task_type is None or task_type == pb.TRAINING:
+                    task = self._todo.popleft() if self._todo else None
             if task is not None:
                 self._doing[task.task_id] = _DoingEntry(
                     worker_id=worker_id, task=task, lease_start=time.time()
                 )
             return task
 
+    # A transiently-failing task (worker can't serve it *yet*) re-queues
+    # without charging a retry, but not unboundedly: past this many
+    # transient bounces it degrades to a normal (retry-charged) failure so
+    # a job where NO worker can ever serve the task still terminates.
+    MAX_TRANSIENT_REQUEUES = 100
+
     def report(self, task_id: int, success: bool, worker_id: int = -1,
-               records: int = 0) -> bool:
+               records: int = 0, transient: bool = False) -> bool:
         """Worker reports a leased task done/failed.  Returns False for an
         unknown lease (e.g. already reaped) — the reference likewise ignores
         stale reports."""
@@ -219,6 +230,18 @@ class TaskManager:
                 self.counters.records_done += records
                 self.counters.by_type[task.type] = (
                     self.counters.by_type.get(task.type, 0) + 1
+                )
+            elif transient and (
+                self._transient_count.get(task_id, 0)
+                < self.MAX_TRANSIENT_REQUEUES
+            ):
+                self._transient_count[task_id] = (
+                    self._transient_count.get(task_id, 0) + 1
+                )
+                self._todo.append(task)
+                logger.info(
+                    "Task %d transiently unserviceable; re-queued "
+                    "(no retry charged)", task_id,
                 )
             else:
                 self.counters.failed += 1

@@ -113,6 +113,19 @@ def main(argv=None):
             checkpoint_saver=saver,
             checkpoint_steps=args.checkpoint_steps,
         )
+    if saver is not None:
+        # Preemptible VMs: SIGTERM arrives with a grace window — flush one
+        # final synchronous checkpoint so the next topology restores from
+        # the last step, not the last periodic save (SURVEY.md §5).
+        from elasticdl_tpu.common.preemption import install_preemption_hook
+
+        save_fn = (
+            worker.save_checkpoint_and_flush
+            if hasattr(worker, "save_checkpoint_and_flush")
+            else worker.model_owner.save_and_flush
+        )
+        install_preemption_hook(save_fn)
+
     ok = worker.run()
     logger.info("Worker %d exiting (clean=%s)", worker_id, ok)
 

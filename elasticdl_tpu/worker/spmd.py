@@ -85,6 +85,7 @@ class SPMDWorker:
         self.trainer: Optional[Trainer] = None
         self.mesh = None
         self.last_loss = None
+        self.remesh_count = 0
 
     # ---- runtime lifecycle --------------------------------------------
 
@@ -195,7 +196,9 @@ class SPMDWorker:
                 # ranks skip together; the leader re-queues the task.
                 if self.is_leader:
                     self._data_service.report_task(
-                        task, err="no trained state for evaluation"
+                        task,
+                        err="no trained state for evaluation",
+                        transient=True,
                     )
                 return
             records = self._evaluate_task(task)
@@ -241,17 +244,26 @@ class SPMDWorker:
         return records
 
     def _evaluate_task(self, task: pb.Task) -> int:
+        from elasticdl_tpu.worker.sync import state_at_version
+
         records = 0
         all_labels, all_preds = [], []
+        eval_state, actual_version = None, None
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
             self._ensure_state(batch)
+            if actual_version is None:
+                # Deterministic across ranks (same state/saver contents),
+                # so every rank restores — or falls back — together.
+                eval_state, actual_version = state_at_version(
+                    self.state, self._saver, task.model_version
+                )
             features = mesh_lib.make_global_batch(
                 batch["features"], self.mesh
             )
             preds = self.trainer.predict_on_global_batch(
-                self.state, features
+                eval_state, features
             )
             # Data-sharded output: gather the full array onto every host
             # so metric fns (host-side, e.g. AUC) see all rows.
@@ -264,8 +276,8 @@ class SPMDWorker:
             preds = np.concatenate(all_preds)
             req = pb.ReportEvaluationMetricsRequest(
                 worker_id=self.worker_id,
-                model_version=task.model_version
-                if task.model_version >= 0
+                model_version=actual_version
+                if actual_version is not None and actual_version >= 0
                 else int(self.state.step),
                 num_examples=records,
             )
@@ -326,9 +338,17 @@ class SPMDWorker:
         self._coordinator = spec.coordinator_address or self._coordinator
         self.state = None  # re-init + checkpoint restore on next batch
         self.setup()
+        self.remesh_count += 1
         return True
 
     # ---- helpers -------------------------------------------------------
+
+    def save_checkpoint_and_flush(self) -> None:
+        """Synchronous final checkpoint (preemption hook: the process is
+        about to die, so wait for the write to land)."""
+        self._save(force=True)
+        if self._saver is not None:
+            self._saver.wait_until_finished()
 
     def _save(self, force: bool = False) -> None:
         # Orbax distributed save: EVERY rank participates (each writes its

@@ -90,17 +90,24 @@ class ModelOwner:
             self._maybe_checkpoint()
             return loss
 
-    def predict_batch(self, batch):
+    def predict_batch(self, batch, state=None):
+        """Forward pass; `state` overrides the owner's current state (eval
+        at a restored version)."""
         with self.lock:
             self.ensure_state(batch)
-            return self.trainer.predict_on_batch(
-                self.state, batch["features"]
-            )
+            use = self.state if state is None else state
+            return self.trainer.predict_on_batch(use, batch["features"])
 
     def save(self, force: bool = False) -> None:
         with self.lock:
             if self.checkpoint_saver is not None and self.state is not None:
                 self.checkpoint_saver.save(self.state, force=force)
+
+    def save_and_flush(self) -> None:
+        """Synchronous final checkpoint (preemption hook)."""
+        self.save(force=True)
+        if self.checkpoint_saver is not None:
+            self.checkpoint_saver.wait_until_finished()
 
     def _maybe_checkpoint(self) -> None:
         if (
@@ -111,6 +118,21 @@ class ModelOwner:
         ):
             self.checkpoint_saver.save(self.state)
 
+    def state_for_eval(self, requested_version: int):
+        """Resolve the state an eval task should score (SURVEY.md §3.5:
+        the reference evaluated the model at the task's version, pulled
+        from the PS — here the checkpoint store is the version archive).
+
+        Returns (state, actual_version): the checkpointed state at the
+        requested version when it is retrievable, else the current state
+        labeled with its TRUE step so the master never aggregates metrics
+        under a version the model isn't at.
+        """
+        with self.lock:
+            return state_at_version(
+                self.state, self.checkpoint_saver, requested_version
+            )
+
     # ---- elastic re-mesh ----------------------------------------------
 
     def remesh(self, mesh) -> None:
@@ -119,3 +141,23 @@ class ModelOwner:
             self.trainer.set_mesh(mesh)
             if self.state is not None:
                 self.state = self.trainer.replace_state(self.state)
+
+
+def state_at_version(state, checkpoint_saver, requested_version: int):
+    """Shared eval-at-version resolution (thread/SPMD workers).
+
+    (state, actual_version) where actual_version is what the metrics must
+    be labeled with."""
+    current = -1 if state is None else int(state.step)
+    if requested_version < 0 or requested_version == current:
+        return state, current
+    if checkpoint_saver is not None and state is not None:
+        restored = checkpoint_saver.restore_step(requested_version, state)
+        if restored is not None:
+            return restored, requested_version
+    logger.info(
+        "Eval at version %d not retrievable (current step %d, no "
+        "checkpoint); evaluating current state",
+        requested_version, current,
+    )
+    return state, current

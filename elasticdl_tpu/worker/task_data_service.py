@@ -75,11 +75,13 @@ class TaskDataService:
                 continue
             return task, False
 
-    def report_task(self, task: pb.Task, err: str = "", records: int = 0):
+    def report_task(self, task: pb.Task, err: str = "", records: int = 0,
+                    transient: bool = False):
         req = pb.ReportTaskResultRequest(
             task_id=task.task_id,
             err_message=err,
             worker_id=self._worker_id,
+            transient=transient,
         )
         req.exec_counters["records"] = records
         try:

@@ -29,6 +29,13 @@ from elasticdl_tpu.worker.trainer import Trainer
 logger = get_logger(__name__)
 
 
+class TransientTaskError(RuntimeError):
+    """The task is fine but THIS worker can't serve it yet (e.g. a fresh
+    replacement pod leasing an eval task before it has trained state).
+    Reported with transient=True: the master re-queues without charging a
+    retry."""
+
+
 class Worker:
     def __init__(
         self,
@@ -128,6 +135,14 @@ class Worker:
                         )
                     except Exception:
                         pass  # advisory only; eval scheduling catches up
+            except TransientTaskError as exc:
+                logger.info(
+                    "Task %d transiently unserviceable on worker %d: %s",
+                    task.task_id, self.worker_id, exc,
+                )
+                self._data_service.report_task(
+                    task, err=str(exc), transient=True
+                )
             except Exception as exc:  # report failure; master re-queues
                 logger.error(
                     "Task %d failed on worker %d: %s",
@@ -177,15 +192,24 @@ class Worker:
             # randomly initialised params.  Re-queue for a worker that has
             # either.  (ADVICE r1: a configured-but-empty checkpoint dir
             # counts as *no* trained state.)
-            raise RuntimeError(
+            raise TransientTaskError(
                 "worker has no trained state for evaluation; re-queueing"
             )
         records = 0
         all_labels, all_preds = [], []
+        eval_state, actual_version = None, None
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
-            preds = self._owner.predict_batch(batch)
+            if actual_version is None:
+                # Eval-at-version (§3.5): score the checkpointed state at
+                # the requested version when retrievable; otherwise label
+                # metrics with the step actually evaluated.
+                self._owner.ensure_state(batch)
+                eval_state, actual_version = self._owner.state_for_eval(
+                    task.model_version
+                )
+            preds = self._owner.predict_batch(batch, state=eval_state)
             all_labels.append(np.asarray(batch["labels"])[:real])
             all_preds.append(preds[:real])
             records += real
@@ -196,8 +220,8 @@ class Worker:
             preds = np.concatenate(all_preds)
             req = pb.ReportEvaluationMetricsRequest(
                 worker_id=self.worker_id,
-                model_version=task.model_version
-                if task.model_version >= 0
+                model_version=actual_version
+                if actual_version is not None and actual_version >= 0
                 else self._owner.step,
                 num_examples=records,
             )

