@@ -22,6 +22,7 @@ layer rewrite — see layers/embedding.py.
 
 from __future__ import annotations
 
+import ast
 import importlib
 import inspect
 import os
@@ -68,9 +69,12 @@ def _call_with_params(fn, params: str):
                 continue
             key, _, value = item.partition("=")
             try:
-                value = eval(value, {"__builtins__": {}})  # noqa: S307
-            except Exception:
-                pass
+                # Literals only (numbers/strings/tuples/dicts/bools) — this
+                # string arrives from job submission, so it must never be
+                # able to execute code on the master or workers.
+                value = ast.literal_eval(value.strip())
+            except (ValueError, SyntaxError):
+                pass  # keep as raw string
             kwargs[key.strip()] = value
     sig = inspect.signature(fn)
     accepted = {

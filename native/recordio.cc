@@ -77,7 +77,9 @@ int64_t recordio_build_index(const char* path, int64_t** out) {
     pos = next;
   }
   std::fclose(f);
-  *out = static_cast<int64_t*>(std::malloc(offsets.size() * sizeof(int64_t)));
+  *out = static_cast<int64_t*>(
+      std::malloc(offsets.size() ? offsets.size() * sizeof(int64_t) : 1));
+  if (!*out) return -4;
   std::memcpy(*out, offsets.data(), offsets.size() * sizeof(int64_t));
   return static_cast<int64_t>(offsets.size());
 }
@@ -91,6 +93,8 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
                               uint8_t** out, int64_t** sizes_out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  const int64_t file_size = std::ftell(f);
   std::vector<uint8_t> buffer;
   std::vector<int64_t> sizes;
   uint8_t header[12];
@@ -102,6 +106,16 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
     }
     uint64_t length;
     std::memcpy(&length, header, 8);
+    // A corrupt on-disk length must hit the clean truncation path (-2),
+    // not an unbounded resize that throws bad_alloc across the ctypes
+    // boundary: the record body + footer must fit inside the file.  The
+    // unsigned pre-check also covers lengths >= 2^63, which would turn
+    // the signed arithmetic below negative (and UB) and slip past it.
+    if (length > static_cast<uint64_t>(file_size) ||
+        offsets[i] + 12 + static_cast<int64_t>(length) + 4 > file_size) {
+      std::fclose(f);
+      return -2;
+    }
     if (check_crc) {
       uint32_t stored;
       std::memcpy(&stored, header + 8, 4);
@@ -129,10 +143,16 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
     sizes.push_back(static_cast<int64_t>(length));
   }
   std::fclose(f);
-  *out = static_cast<uint8_t*>(std::malloc(buffer.size()));
+  *out = static_cast<uint8_t*>(std::malloc(buffer.size() ? buffer.size() : 1));
+  if (!*out) return -4;
   std::memcpy(*out, buffer.data(), buffer.size());
-  *sizes_out =
-      static_cast<int64_t*>(std::malloc(sizes.size() * sizeof(int64_t)));
+  *sizes_out = static_cast<int64_t*>(
+      std::malloc(sizes.size() ? sizes.size() * sizeof(int64_t) : 1));
+  if (!*sizes_out) {
+    std::free(*out);
+    *out = nullptr;
+    return -4;
+  }
   std::memcpy(*sizes_out, sizes.data(), sizes.size() * sizeof(int64_t));
   return static_cast<int64_t>(buffer.size());
 }

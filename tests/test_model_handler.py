@@ -1,0 +1,31 @@
+"""Model-zoo contract loading: `--model_params` parsing must accept
+literals only — job-submission input must never execute code (the
+reference passed this string into user-module functions the same way)."""
+
+from elasticdl_tpu.common.model_handler import _call_with_params
+
+
+def _fn(a=None, b=None, c=None):
+    return {"a": a, "b": b, "c": c}
+
+
+def test_literals_parse():
+    out = _call_with_params(_fn, "a=1;b=1e-3;c=(2, 3)")
+    assert out == {"a": 1, "b": 1e-3, "c": (2, 3)}
+
+
+def test_bare_strings_stay_strings():
+    out = _call_with_params(_fn, "a=hello;b='quoted'")
+    assert out["a"] == "hello" and out["b"] == "quoted"
+
+
+def test_expressions_do_not_execute():
+    # Anything that is not a pure literal must come through as the raw
+    # string, never evaluated.
+    out = _call_with_params(_fn, "a=__import__('os').getpid()")
+    assert out["a"] == "__import__('os').getpid()"
+
+
+def test_unknown_keys_filtered():
+    out = _call_with_params(_fn, "a=1;zzz=9")
+    assert out == {"a": 1, "b": None, "c": None}

@@ -59,6 +59,23 @@ def test_corruption_detected(tf_file):
     assert len(records) == 20
 
 
+def test_corrupt_length_is_clean_error(tf_file):
+    """A huge bogus on-disk length must return the clean truncation error,
+    not throw bad_alloc across the ctypes boundary."""
+    import struct
+
+    path, _ = tf_file
+    offsets = native_io.build_index(path)
+    # both a huge positive length and one with the top bit set (which
+    # would go negative under a naive signed cast) must error cleanly
+    for bogus in (1 << 60, 0xFFFFFFFFFFFFFFFF):
+        with open(path, "r+b") as f:  # overwrite record 5's length field
+            f.seek(offsets[5])
+            f.write(struct.pack("<Q", bogus))
+        with pytest.raises(IOError):
+            native_io.read_records(path, offsets, 0, 20, check_crc=False)
+
+
 def test_truncated_file_rejected(tmp_path):
     path = str(tmp_path / "trunc.tfrecord")
     write_tfrecords(path, [b"x" * 100])
