@@ -105,6 +105,11 @@ class TaskManager:
         self._epoch = 0 if training_shards else num_epochs
         self._task_retry_count: Dict[int, int] = {}
         self._transient_count: Dict[int, int] = {}
+        # task_id -> earliest leasable time: a transiently re-queued task
+        # is briefly held so the SAME worker cannot re-lease it in a tight
+        # RPC loop and burn its whole transient budget in seconds
+        # (ADVICE r2) — another worker gets the window to serve it.
+        self._transient_hold: Dict[int, float] = {}
         self.counters = TaskCounters()
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
@@ -180,15 +185,23 @@ class TaskManager:
                 # worker already declared dead.
                 return None
             task = None
+            now = time.time()
             if task_type is None:
-                if self._todo:
-                    task = self._todo.popleft()
-            else:
                 for i, cand in enumerate(self._todo):
-                    if cand.type == task_type:
+                    if self._transient_hold.get(cand.task_id, 0) <= now:
                         del self._todo[i]
                         task = cand
                         break
+            else:
+                for i, cand in enumerate(self._todo):
+                    if cand.type == task_type and (
+                        self._transient_hold.get(cand.task_id, 0) <= now
+                    ):
+                        del self._todo[i]
+                        task = cand
+                        break
+            if task is not None:
+                self._transient_hold.pop(task.task_id, None)
             if (
                 task is None
                 and not self._doing
@@ -213,6 +226,8 @@ class TaskManager:
     # transient bounces it degrades to a normal (retry-charged) failure so
     # a job where NO worker can ever serve the task still terminates.
     MAX_TRANSIENT_REQUEUES = 100
+    # Hold window before a transiently re-queued task is leasable again.
+    TRANSIENT_HOLD_S = 1.0
 
     def report(self, task_id: int, success: bool, worker_id: int = -1,
                records: int = 0, transient: bool = False) -> bool:
@@ -237,6 +252,9 @@ class TaskManager:
             ):
                 self._transient_count[task_id] = (
                     self._transient_count.get(task_id, 0) + 1
+                )
+                self._transient_hold[task_id] = (
+                    time.time() + self.TRANSIENT_HOLD_S
                 )
                 self._todo.append(task)
                 logger.info(

@@ -134,6 +134,9 @@ def test_transient_failure_requeues_without_burning_retries():
         training_shards=create_shards_from_ranges([("f", 0, 64)], 64),
         max_task_retries=2,
     )
+    # Collapse the anti-tight-loop hold (tested in test_task_manager) so
+    # this test can exercise the budget semantics directly.
+    tm.TRANSIENT_HOLD_S = 0.0
     task = tm.get(worker_id=0)
     for _ in range(10):  # way past max_task_retries
         tm.report(task.task_id, success=False, transient=True)

@@ -144,3 +144,30 @@ def test_shuffle_is_deterministic_with_seed():
         orders.append(order)
     assert orders[0] == orders[1]
     assert orders[0] != sorted(orders[0])  # actually shuffled
+
+
+def test_transient_requeue_is_held_before_release():
+    """A transiently re-queued task must not be immediately re-leasable
+    (ADVICE r2: the reporting worker would otherwise bounce it through its
+    whole transient budget in a tight RPC loop)."""
+    import time
+
+    tm = make_tm(records=10, per_task=10)  # exactly one task
+    task = tm.get(0)
+    tm.report(task.task_id, success=False, transient=True)
+    # held: not leasable right away, by anyone
+    assert tm.get(0) is None
+    assert tm.get(1) is None
+    time.sleep(tm.TRANSIENT_HOLD_S + 0.1)
+    again = tm.get(1)
+    assert again is not None and again.task_id == task.task_id
+    tm.report(again.task_id, success=True)
+    assert tm.finished
+
+
+def test_held_task_does_not_block_other_tasks():
+    tm = make_tm(records=20, per_task=10)  # two tasks
+    first = tm.get(0)
+    tm.report(first.task_id, success=False, transient=True)
+    other = tm.get(0)  # the second task leases right past the held one
+    assert other is not None and other.task_id != first.task_id
