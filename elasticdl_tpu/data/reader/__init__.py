@@ -1,5 +1,15 @@
-"""Reader factory.  Parity: reference data reader creation from
---training_data + --data_reader_params (SURVEY.md C12)."""
+"""Reader factory + pluggable registry.
+
+Parity: reference data reader creation from --training_data +
+--data_reader_params (SURVEY.md C12).  The reference shipped RecordIO /
+ODPS-table / CSV readers behind one `create_data_reader`; third-party
+sources plugged in by module edit.  Here they plug in by REGISTRATION: a
+model-zoo module calls `register_data_reader("myscheme", MyReader)` at
+import time, and any `--training_data myscheme://...` origin dispatches to
+it — no framework edits.
+"""
+
+from typing import Dict, Type
 
 from elasticdl_tpu.data.reader.base import AbstractDataReader  # noqa: F401
 from elasticdl_tpu.data.reader.csv_reader import CSVDataReader  # noqa: F401
@@ -8,12 +18,61 @@ from elasticdl_tpu.data.reader.tfrecord_reader import (  # noqa: F401
     TFRecordDataReader,
 )
 
+_REGISTRY: Dict[str, Type[AbstractDataReader]] = {}
+
+
+def register_data_reader(scheme: str, reader_cls=None):
+    """Register a reader class for a `scheme://` origin prefix (or a
+    `reader_type=scheme` kwarg).  Usable as a call or a decorator:
+
+        @register_data_reader("odps")
+        class ODPSReader(AbstractDataReader): ...
+    """
+    def _register(cls):
+        if not issubclass(cls, AbstractDataReader):
+            raise TypeError(
+                f"{cls!r} must subclass AbstractDataReader to register"
+            )
+        _REGISTRY[scheme] = cls
+        return cls
+
+    if reader_cls is not None:
+        return _register(reader_cls)
+    return _register
+
+
+register_data_reader("csv", CSVDataReader)
+register_data_reader("tfrecord", TFRecordDataReader)
+
 
 def create_data_reader(data_origin: str, **kwargs) -> AbstractDataReader:
-    """Pick a reader from the data path: .csv -> CSV, else TFRecord.
-    Custom readers come from the model-zoo module's `custom_data_reader`
-    (handled by the model handler, not here)."""
-    if data_origin.endswith(".csv") or kwargs.pop("reader_type", "") == "csv":
+    """Dispatch on origin:
+
+    1. `scheme://rest` -> the registered reader for `scheme` (rest becomes
+       its data_dir) — the pluggable path.
+    2. `reader_type=<scheme>` kwarg -> same registry, origin passed whole.
+    3. Fallback heuristics: .csv paths/dirs -> CSV, else TFRecord.
+
+    Custom per-job readers can also come from the model-zoo module's
+    `custom_data_reader` (handled by the model handler, not here).
+    """
+    if "://" in data_origin:
+        scheme, rest = data_origin.split("://", 1)
+        if scheme not in _REGISTRY:
+            raise ValueError(
+                f"no data reader registered for scheme {scheme!r} "
+                f"(registered: {sorted(_REGISTRY)})"
+            )
+        return _REGISTRY[scheme](data_dir=rest, **kwargs)
+    reader_type = kwargs.pop("reader_type", "")
+    if reader_type:
+        if reader_type not in _REGISTRY:
+            raise ValueError(
+                f"no data reader registered for reader_type "
+                f"{reader_type!r} (registered: {sorted(_REGISTRY)})"
+            )
+        return _REGISTRY[reader_type](data_dir=data_origin, **kwargs)
+    if data_origin.endswith(".csv"):
         return CSVDataReader(data_dir=data_origin, **kwargs)
     import os
 

@@ -4,6 +4,7 @@ TPU-native stand-in for the reference's RecordIODataReader)."""
 from __future__ import annotations
 
 import os
+import threading
 from typing import Iterator, List, Tuple
 
 from elasticdl_tpu.data.record_io import TFRecordReader
@@ -12,12 +13,17 @@ from elasticdl_tpu.data.reader.base import AbstractDataReader
 
 class TFRecordDataReader(AbstractDataReader):
     """Reads a directory of (or a single) .tfrecord file(s); shard name is
-    the file path, record addressing via the sidecar offset index."""
+    the file path, record addressing via the sidecar offset index.
+
+    Safe to share across worker threads: the per-file reader cache is
+    lock-guarded and TFRecordReader itself reads via pread (no shared file
+    position)."""
 
     def __init__(self, data_dir: str, **kwargs):
         super().__init__(**kwargs)
         self._data_dir = data_dir
         self._readers = {}
+        self._lock = threading.Lock()
 
     def _files(self) -> List[str]:
         if os.path.isfile(self._data_dir):
@@ -29,9 +35,10 @@ class TFRecordDataReader(AbstractDataReader):
         )
 
     def _reader(self, name: str) -> TFRecordReader:
-        if name not in self._readers:
-            self._readers[name] = TFRecordReader(name)
-        return self._readers[name]
+        with self._lock:
+            if name not in self._readers:
+                self._readers[name] = TFRecordReader(name)
+            return self._readers[name]
 
     def read_records(self, task) -> Iterator[bytes]:
         reader = self._reader(task.shard.name)

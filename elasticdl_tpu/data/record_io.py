@@ -154,14 +154,21 @@ def load_or_build_index(path: str, cache: bool = True) -> List[int]:
 
 
 class TFRecordReader:
-    """Random-access reader over an indexed TFRecord file."""
+    """Random-access reader over an indexed TFRecord file.
+
+    Thread-safe by construction: the offset index is immutable after
+    __init__ and every read is an `os.pread` at an absolute offset — no
+    shared file-position state — so one reader instance can serve
+    concurrent worker threads (local mode hands one reader to every
+    worker; ADVICE r2: the previous seek+read pair interleaved under
+    concurrency and yielded corrupt records)."""
 
     def __init__(self, path: str, check_crc: bool = False,
                  cache_index: bool = True):
         self._path = path
         self._check_crc = check_crc
         self._offsets = load_or_build_index(path, cache=cache_index)
-        self._f = open(path, "rb")
+        self._fd = os.open(path, os.O_RDONLY)
 
     def __len__(self) -> int:
         return len(self._offsets)
@@ -176,21 +183,34 @@ class TFRecordReader:
             )
             return
         for i in range(start, end):
-            self._f.seek(self._offsets[i])
-            header = self._f.read(8)
-            (length,) = struct.unpack("<Q", header)
-            stored_hdr_crc = struct.unpack("<I", self._f.read(4))[0]
-            payload = self._f.read(length)
-            stored_crc = struct.unpack("<I", self._f.read(4))[0]
+            offset = self._offsets[i]
+            header = os.pread(self._fd, 12, offset)
+            if len(header) < 12:
+                raise IOError(f"{self._path}: truncated header @record {i}")
+            (length,) = struct.unpack("<Q", header[:8])
+            body = os.pread(self._fd, length + 4, offset + 12)
+            if len(body) < length + 4:
+                raise IOError(f"{self._path}: truncated record @record {i}")
+            payload = body[:length]
             if self._check_crc:
-                if stored_hdr_crc != _masked_crc(header):
+                stored_hdr_crc = struct.unpack("<I", header[8:12])[0]
+                stored_crc = struct.unpack("<I", body[length:])[0]
+                if stored_hdr_crc != _masked_crc(header[:8]):
                     raise IOError(f"{self._path}: header CRC mismatch @record {i}")
                 if stored_crc != _masked_crc(payload):
                     raise IOError(f"{self._path}: payload CRC mismatch @record {i}")
             yield payload
 
     def close(self):
-        self._f.close()
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __enter__(self):
         return self

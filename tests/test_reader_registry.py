@@ -1,0 +1,224 @@
+"""Reader depth (SURVEY.md C12, round-2 verdict gap #5): pluggable reader
+registry, streaming CSV with bounded memory, thread-safe pread fallback."""
+
+import csv
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.reader import (
+    CSVDataReader,
+    create_data_reader,
+    register_data_reader,
+)
+from elasticdl_tpu.data.reader.base import AbstractDataReader
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def _task(name, start, end):
+    return pb.Task(shard=pb.Shard(name=name, start=start, end=end))
+
+
+# ---- registry -----------------------------------------------------------
+
+
+def test_scheme_dispatch_and_errors(tmp_path):
+    @register_data_reader("sq")
+    class SquareReader(AbstractDataReader):
+        def __init__(self, data_dir="", **kw):
+            super().__init__(**kw)
+            self.n = int(data_dir)
+
+        def read_records(self, task):
+            for i in range(task.shard.start, min(task.shard.end, self.n)):
+                yield i * i
+
+        def create_shards(self):
+            return [("sq", 0, self.n)]
+
+    reader = create_data_reader("sq://5")
+    assert isinstance(reader, SquareReader)
+    assert list(reader.read_records(_task("sq", 1, 4))) == [1, 4, 9]
+    with pytest.raises(ValueError, match="no data reader registered"):
+        create_data_reader("nosuch://x")
+    with pytest.raises(ValueError, match="no data reader registered"):
+        create_data_reader("/tmp/x", reader_type="nosuch")
+    with pytest.raises(TypeError):
+        register_data_reader("bad", object)
+
+
+def test_zoo_module_registered_reader_drives_full_job(tmp_path):
+    """The done-criterion: a reader registered from a model-zoo module
+    (imported the way jobs import zoo code) serves a complete local job,
+    including the master's create_shards."""
+    zoo = tmp_path / "zoo"
+    zoo.mkdir()
+    (zoo / "synth.py").write_text(
+        '''
+import numpy as np
+import optax
+from flax import linen as nn
+
+from elasticdl_tpu.data.reader import register_data_reader
+from elasticdl_tpu.data.reader.base import AbstractDataReader
+
+
+@register_data_reader("synth")
+class SynthReader(AbstractDataReader):
+    """y = 2x + 1 with noise, generated on the fly: no files at all."""
+
+    def __init__(self, data_dir="", **kw):
+        super().__init__(**kw)
+        self.n = int(data_dir)
+
+    def read_records(self, task):
+        rng = np.random.RandomState(0)
+        xs = rng.rand(self.n).astype("float32")
+        for i in range(task.shard.start, min(task.shard.end, self.n)):
+            yield (xs[i], 2.0 * xs[i] + 1.0)
+
+    def create_shards(self):
+        return [("synth", 0, self.n)]
+
+
+class Linear(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return Linear()
+
+
+def loss(labels, predictions):
+    import jax.numpy as jnp
+    return jnp.mean((predictions.squeeze(-1) - labels) ** 2)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def feed(records, metadata):
+    xs = np.array([r[0] for r in records], "float32")[:, None]
+    ys = np.array([r[1] for r in records], "float32")
+    return {"features": xs, "labels": ys}
+'''
+    )
+    from elasticdl_tpu.client.main import main as cli_main
+
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", str(zoo),
+            "--model_def", "synth.custom_model",
+            "--training_data", "synth://256",
+            "--distribution_strategy", "Local",
+            "--num_epochs", "2",
+            "--minibatch_size", "32",
+            "--records_per_task", "64",
+            "--num_workers", "2",
+        ]
+    )
+    assert rc == 0
+
+
+# ---- streaming CSV ------------------------------------------------------
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    path = str(tmp_path / "data.csv")
+    rows = [[f"name{i}", str(i), f"{i * 0.5:.2f}"] for i in range(100)]
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["name", "count", "score"])
+        writer.writerows(rows)
+    return path, rows
+
+
+def test_csv_rows_match_and_header(csv_file):
+    path, rows = csv_file
+    reader = CSVDataReader(data_dir=path)
+    shards = reader.create_shards()
+    assert shards == [(path, 0, 100)]
+    assert list(reader.read_records(_task(path, 10, 20))) == rows[10:20]
+    assert list(reader.read_records(_task(path, 95, 200))) == rows[95:]
+    assert reader.metadata["columns"] == ["name", "count", "score"]
+
+
+def test_csv_quoted_fields_and_no_header(tmp_path):
+    path = str(tmp_path / "q.csv")
+    with open(path, "w", newline="") as f:
+        csv.writer(f).writerows([["a,b", "1"], ["c\"d", "2"]])
+    reader = CSVDataReader(data_dir=path, has_header=False)
+    assert reader.create_shards() == [(path, 0, 2)]
+    assert list(reader.read_records(_task(path, 0, 2))) == [
+        ["a,b", "1"], ['c"d', "2"]
+    ]
+
+
+def test_csv_concurrent_reads_are_consistent(csv_file):
+    """One shared reader, many threads, disjoint ranges: every thread must
+    see exactly its own rows (the pre-round-3 cache was also shared, but a
+    shared *file position* would interleave under the old seek model)."""
+    path, rows = csv_file
+    reader = CSVDataReader(data_dir=path)
+    reader.create_shards()
+    results, errors = {}, []
+
+    def work(tid, start, end):
+        try:
+            for _ in range(20):
+                got = list(reader.read_records(_task(path, start, end)))
+                assert got == rows[start:end]
+            results[tid] = True
+        except Exception as exc:  # pragma: no cover
+            errors.append((tid, exc))
+
+    threads = [
+        threading.Thread(target=work, args=(t, t * 10, t * 10 + 10))
+        for t in range(10)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors and len(results) == 10
+
+
+# ---- thread-safe TFRecord fallback --------------------------------------
+
+
+def test_tfrecord_python_fallback_concurrent(tmp_path, monkeypatch):
+    """Force the pure-Python path and hammer one reader from many threads:
+    pread-based reads must never interleave (round-2 ADVICE medium)."""
+    import elasticdl_tpu.data.record_io as rio
+    from elasticdl_tpu.data.record_io import TFRecordReader, write_tfrecords
+
+    monkeypatch.setattr(rio, "_try_native", lambda: None)
+    path = str(tmp_path / "c.tfrecord")
+    payloads = [bytes([i % 256]) * (10 + i % 7) for i in range(200)]
+    write_tfrecords(path, payloads)
+    reader = TFRecordReader(path, check_crc=True)
+    errors = []
+
+    def work(start, end):
+        try:
+            for _ in range(30):
+                assert list(reader.read(start, end)) == payloads[start:end]
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=work, args=(t * 20, t * 20 + 20))
+        for t in range(10)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
