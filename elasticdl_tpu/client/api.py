@@ -68,10 +68,15 @@ def _train_local(args, job_type: str = "train") -> int:
         "evaluate": args.validation_data,
         "predict": args.prediction_data,
     }[job_type]
-    if spec.custom_data_reader is not None:
-        reader = spec.custom_data_reader(data_origin=data_origin)
-    else:
-        reader = create_data_reader(data_origin)
+    def make_reader():
+        # One reader PER worker thread: the built-in readers are
+        # thread-safe (pread-based), but zoo-contributed readers carry no
+        # such contract, so never share an instance across workers.
+        if spec.custom_data_reader is not None:
+            return spec.custom_data_reader(data_origin=data_origin)
+        return create_data_reader(data_origin)
+
+    reader = make_reader()
 
     from elasticdl_tpu.common.save_utils import CheckpointSaver
 
@@ -121,13 +126,21 @@ def _train_local(args, job_type: str = "train") -> int:
     workers = []
     threads = []
     for wid in range(args.num_workers):
+        tb_dir = ""
+        if getattr(args, "tensorboard_log_dir", ""):
+            import os
+
+            tb_dir = os.path.join(
+                args.tensorboard_log_dir, f"worker-{wid}"
+            )
         worker = Worker(
             worker_id=wid,
             master_client=client,
-            data_reader=reader,
+            data_reader=reader if wid == 0 else make_reader(),
             spec=spec,
             minibatch_size=args.minibatch_size,
             model_owner=owner,
+            tensorboard_dir=tb_dir,
         )
         workers.append(worker)
         thread = threading.Thread(target=worker.run, daemon=True)

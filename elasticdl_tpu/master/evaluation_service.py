@@ -47,8 +47,10 @@ class EvaluationService:
         start_delay_secs: int = 0,
         throttle_secs: int = 0,
         eval_only_at_end: bool = False,
+        summary_writer=None,
     ):
         self._tm = task_manager
+        self._summary = summary_writer
         self._evaluation_steps = evaluation_steps
         self._start_delay_secs = start_delay_secs
         self._throttle_secs = throttle_secs
@@ -101,6 +103,17 @@ class EvaluationService:
             "Eval metrics v%d (n=%d): %s",
             req.model_version, agg.num_examples, self.history[req.model_version],
         )
+        if self._summary is not None:
+            # Master-side TensorBoard: job-level (cross-shard aggregated)
+            # eval curve, re-written as shards accumulate for a version.
+            self._summary.scalars(
+                {
+                    f"eval/{k}": v
+                    for k, v in self.history[req.model_version].items()
+                },
+                step=req.model_version,
+            )
+            self._summary.flush()
 
     def latest_metrics(self) -> Optional[Dict[str, float]]:
         with self._lock:

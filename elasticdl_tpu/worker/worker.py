@@ -29,6 +29,21 @@ from elasticdl_tpu.worker.trainer import Trainer
 logger = get_logger(__name__)
 
 
+def invoke_callbacks(callbacks, hook: str, *args) -> None:
+    """Fire one zoo-callback hook on every callback that implements it.
+    Hook points (reference C14 semantics, SURVEY.md): on_task_start(task),
+    on_task_end(task, records), on_job_end().  A raising callback is
+    logged, never fatal — user code must not kill the task loop."""
+    for cb in callbacks or ():
+        fn = getattr(cb, hook, None)
+        if fn is None:
+            continue
+        try:
+            fn(*args)
+        except Exception:
+            logger.exception("callback %r failed in %s", cb, hook)
+
+
 class TransientTaskError(RuntimeError):
     """The task is fine but THIS worker can't serve it yet (e.g. a fresh
     replacement pod leasing an eval task before it has trained state).
@@ -51,6 +66,7 @@ class Worker:
         checkpoint_steps: int = 0,
         elastic_manager=None,
         model_owner: Optional[ModelOwner] = None,
+        tensorboard_dir: str = "",
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -92,6 +108,14 @@ class Worker:
 
         self.losses = deque(maxlen=1024)
         self._elastic = elastic_manager
+        # Observability (SURVEY.md §5): rolling step rate + TensorBoard
+        # scalars.  Both are cheap no-ops when no tensorboard_dir is set
+        # (the timer costs one perf_counter per batch).
+        from elasticdl_tpu.common.profiler import StepTimer
+        from elasticdl_tpu.common.summary import SummaryWriter
+
+        self.step_timer = StepTimer()
+        self._summary = SummaryWriter(tensorboard_dir or None)
 
     # ---- owner passthroughs (tests and the client API read these) ------
 
@@ -120,11 +144,19 @@ class Worker:
             task, finished = self._data_service.get_task()
             if finished:
                 logger.info("Job finished; worker %d exiting", self.worker_id)
+                if self.step_timer.steps_per_sec:
+                    self.step_timer.log(f"worker {self.worker_id}: ")
+                self._summary.close()
+                invoke_callbacks(self.spec.callbacks, "on_job_end")
                 return True
             self._maybe_remesh()
             try:
+                invoke_callbacks(self.spec.callbacks, "on_task_start", task)
                 records = self._process_task(task)
                 self._data_service.report_task(task, records=records)
+                invoke_callbacks(
+                    self.spec.callbacks, "on_task_end", task, records
+                )
                 if task.type == pb.TRAINING:
                     try:
                         self._client.report_version(
@@ -175,12 +207,24 @@ class Worker:
 
     def _train_task(self, task: pb.Task) -> int:
         records = 0
+        loss = None
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
             loss = self._owner.train_batch(batch)
+            self.step_timer.tick()
             records += real
             self.losses.append(loss)
+        if loss is not None:
+            # One scalar write per TASK, not per step: forcing the loss to
+            # host every batch would serialize the device pipeline.
+            self._summary.scalars(
+                {
+                    "train/loss": float(np.asarray(loss)),
+                    "train/steps_per_sec": self.step_timer.steps_per_sec,
+                },
+                step=self._owner.step,
+            )
         return records
 
     def _evaluate_task(self, task: pb.Task) -> int:
@@ -228,6 +272,10 @@ class Worker:
             for name, fn in self.spec.eval_metrics.items():
                 req.metrics[name] = float(fn(labels, preds))
             self._client.report_evaluation_metrics(req)
+            self._summary.scalars(
+                {f"eval/{k}": v for k, v in req.metrics.items()},
+                step=req.model_version,
+            )
         return records
 
     def _predict_task(self, task: pb.Task) -> int:

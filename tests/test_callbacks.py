@@ -1,0 +1,94 @@
+"""Zoo `callbacks()` contract (round-2 verdict: loaded but never invoked).
+Hook points: on_task_start(task), on_task_end(task, records), on_job_end()."""
+
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def zoo(tmp_path):
+    zoo_dir = tmp_path / "zoo"
+    zoo_dir.mkdir()
+    (zoo_dir / "cbmodel.py").write_text(
+        '''
+import numpy as np
+import optax
+from flax import linen as nn
+
+EVENTS = []
+
+
+class Recorder:
+    def on_task_start(self, task):
+        EVENTS.append(("start", task.task_id))
+
+    def on_task_end(self, task, records):
+        EVENTS.append(("end", task.task_id, records))
+
+    def on_job_end(self):
+        EVENTS.append(("job_end",))
+
+
+class Exploder:
+    def on_task_start(self, task):
+        raise RuntimeError("user callback bug")  # must not kill the loop
+
+
+def callbacks():
+    return [Recorder(), Exploder()]
+
+
+class Linear(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return Linear()
+
+
+def loss(labels, predictions):
+    import jax.numpy as jnp
+    return jnp.mean((predictions.squeeze(-1) - labels) ** 2)
+
+
+def optimizer(lr=0.1):
+    return optax.sgd(lr)
+
+
+def feed(records, metadata):
+    xs = np.array([float(r.decode()) for r in records], "float32")[:, None]
+    return {"features": xs, "labels": 2.0 * xs.squeeze(-1)}
+'''
+    )
+    return str(zoo_dir)
+
+
+def test_callbacks_fire_at_hook_points(zoo, tmp_path):
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.data.record_io import write_tfrecords
+
+    data = str(tmp_path / "train.tfrecord")
+    write_tfrecords(data, [str(float(i)).encode() for i in range(128)])
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", zoo,
+            "--model_def", "cbmodel.custom_model",
+            "--training_data", data,
+            "--distribution_strategy", "Local",
+            "--num_epochs", "1",
+            "--minibatch_size", "32",
+            "--records_per_task", "64",
+        ]
+    )
+    assert rc == 0
+    events = sys.modules["cbmodel"].EVENTS
+    starts = [e for e in events if e[0] == "start"]
+    ends = [e for e in events if e[0] == "end"]
+    assert len(starts) == 2 and len(ends) == 2  # 128 records / 64 per task
+    assert all(e[2] == 64 for e in ends)  # records passed to on_task_end
+    assert events[-1] == ("job_end",)  # fired once, after all tasks
+    assert sum(1 for e in events if e == ("job_end",)) == 1
