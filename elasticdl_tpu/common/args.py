@@ -70,6 +70,25 @@ def add_common_params(parser: argparse.ArgumentParser):
         "(dev/test: exercises the full elastic control plane with no "
         "cluster)",
     )
+    parser.add_argument(
+        "--use_process_k8s", type=str2bool, default=False,
+        help="Run worker pods as local OS subprocesses (single-machine "
+        "e2e: the full master+worker entry points, rendezvous and "
+        "jax.distributed bootstrap with no Kubernetes — the minikube-CI "
+        "equivalent)",
+    )
+    parser.add_argument(
+        "--wedge_grace_s", type=float, default=20.0,
+        help="Seconds a rank may lag a membership-epoch change before its "
+        "watchdog assumes it is wedged in a collective with a dead peer "
+        "and restarts the process",
+    )
+    parser.add_argument(
+        "--coordinator_port", type=pos_int, default=51001,
+        help="Port of the JAX coordination service bound by rank 0; the "
+        "rendezvous serves rank 0's address + this port as the "
+        "coordinator address",
+    )
 
 
 def add_model_params(parser: argparse.ArgumentParser):
@@ -109,6 +128,12 @@ def add_train_params(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--checkpoint_dir_for_init", default="",
         help="checkpoint to warm-start from",
+    )
+    parser.add_argument(
+        "--tensorboard_log_dir", default="",
+        help="write train-loss/steps-per-sec/eval scalars (workers) and "
+        "aggregated eval metrics (master) as TensorBoard event files "
+        "under this directory",
     )
     parser.add_argument("--task_fault_tolerance", type=str2bool, default=True)
     parser.add_argument(

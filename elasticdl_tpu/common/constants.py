@@ -39,6 +39,16 @@ class DistributionStrategy:
 class WorkerEnv:
     MASTER_ADDR = "ELASTICDL_MASTER_ADDR"
     WORKER_ID = "ELASTICDL_WORKER_ID"
+    # The worker's own reachable address, injected via the k8s downward
+    # API (pod IP).  Falls back to source-address discovery toward the
+    # master when unset (common/net_utils.py).
+    WORKER_ADDR = "ELASTICDL_WORKER_ADDR"
+
+
+# Interval at which workers self-report liveness (+ their address) to the
+# master over keep_alive; the master logs workers silent for several
+# multiples of this.
+KEEP_ALIVE_INTERVAL_S = 10.0
 
 
 # Default lease duration before a "doing" task is considered abandoned and

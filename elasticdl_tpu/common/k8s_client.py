@@ -24,7 +24,10 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
 
-EventCallback = Callable[[str, str], None]  # (pod_name, phase)
+# (pod_name, phase, pod_address) — address is "" until the cluster layer
+# knows the pod's reachable IP (real k8s can emit RUNNING before the IP is
+# assigned; workers self-report via keep_alive to close that gap).
+EventCallback = Callable[[str, str, str], None]
 
 
 @dataclass
@@ -60,6 +63,12 @@ class AbstractK8sClient:
     def start_watch(self, callback: EventCallback) -> None:
         raise NotImplementedError
 
+    def master_host(self, job_name: str) -> str:
+        """Hostname worker pods use to reach the master.  Real clusters
+        resolve the master Service's DNS name; process-backed local
+        clusters are loopback."""
+        return f"{job_name}-master"
+
 
 class FakeK8sClient(AbstractK8sClient):
     """In-memory cluster: pods transition Pending -> Running on create;
@@ -81,7 +90,10 @@ class FakeK8sClient(AbstractK8sClient):
         self._emit(spec.name, PodStatus.PENDING)
         with self._lock:
             self.phases[spec.name] = PodStatus.RUNNING
-        self._emit(spec.name, PodStatus.RUNNING)
+        # Fabricated per-pod address, mirroring pod.status.pod_ip.
+        self._emit(
+            spec.name, PodStatus.RUNNING, f"10.0.0.{spec.worker_id + 1}"
+        )
 
     def create_service(
         self, name: str, selector: Dict[str, str], port: int
@@ -107,15 +119,145 @@ class FakeK8sClient(AbstractK8sClient):
 
     # ---- test hooks ----------------------------------------------------
 
-    def emit(self, pod_name: str, phase: str):
+    def emit(self, pod_name: str, phase: str, address: str = ""):
         """Inject a synthetic pod event (e.g. preemption -> FAILED)."""
         with self._lock:
             self.phases[pod_name] = phase
-        self._emit(pod_name, phase)
+        self._emit(pod_name, phase, address)
 
-    def _emit(self, name: str, phase: str):
+    def _emit(self, name: str, phase: str, address: str = ""):
         if self._callback is not None:
-            self._callback(name, phase)
+            self._callback(name, phase, address)
+
+
+class ProcessK8sClient(AbstractK8sClient):
+    """Local 'cluster' whose pods are OS subprocesses.
+
+    The e2e equivalent of the reference's minikube CI jobs (SURVEY.md
+    §4.4) without Kubernetes: `create_pod` spawns the pod command as a
+    child process, a monitor thread maps process exit to pod phases
+    (rc==0 -> Succeeded, else Failed), and `delete_pod` terminates the
+    child.  Every pod's address is loopback, so the full cluster path —
+    master entry point, worker entry point, rendezvous-served coordinator
+    address, jax.distributed bootstrap — runs unmodified on one machine."""
+
+    def __init__(self, extra_env: Optional[Dict[str, str]] = None):
+        self._lock = threading.Lock()
+        self.pods: Dict[str, PodSpec] = {}
+        self.procs: Dict[str, "subprocess.Popen"] = {}
+        self.phases: Dict[str, str] = {}
+        self.create_calls: List[PodSpec] = []
+        self._output: Dict[str, List[bytes]] = {}
+        self._extra_env = dict(extra_env or {})
+        self._callback: Optional[EventCallback] = None
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    def master_host(self, job_name: str) -> str:
+        return "127.0.0.1"
+
+    def create_pod(self, spec: PodSpec) -> None:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env.update(self._extra_env)
+        with self._lock:
+            self.pods[spec.name] = spec
+            self.create_calls.append(spec)
+            self.phases[spec.name] = PodStatus.PENDING
+        self._emit(spec.name, PodStatus.PENDING)
+        proc = subprocess.Popen(
+            spec.command,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        # Drain continuously: a child that fills an unread 64KB pipe blocks
+        # on write() and wedges — indistinguishable from a real hang.
+        chunks: List[bytes] = []
+
+        def drain():
+            for line in proc.stdout:
+                chunks.append(line)
+
+        threading.Thread(target=drain, daemon=True).start()
+        with self._lock:
+            self.procs[spec.name] = proc
+            self._output[spec.name] = chunks
+            self.phases[spec.name] = PodStatus.RUNNING
+        self._emit(spec.name, PodStatus.RUNNING, "127.0.0.1")
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            proc = self.procs.get(name)
+            self.phases[name] = PodStatus.DELETED
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+        self._emit(name, PodStatus.DELETED)
+
+    def kill_pod(self, name: str) -> None:
+        """Hard preemption (test hook): SIGKILL, then the monitor reports
+        the death as FAILED exactly like a spot reclaim."""
+        with self._lock:
+            proc = self.procs.get(name)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def get_pod_phase(self, name: str) -> str:
+        with self._lock:
+            return self.phases.get(name, PodStatus.UNKNOWN)
+
+    def start_watch(self, callback: EventCallback) -> None:
+        self._callback = callback
+        self._monitor = threading.Thread(target=self._watch_loop, daemon=True)
+        self._monitor.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            procs = list(self.procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+    def pod_output(self, name: str) -> str:
+        with self._lock:
+            chunks = list(self._output.get(name, ()))
+        return b"".join(chunks).decode(errors="replace")
+
+    def _watch_loop(self):
+        import time as _time
+
+        while not self._stop.is_set():
+            with self._lock:
+                snapshot = [
+                    (name, proc)
+                    for name, proc in self.procs.items()
+                    if self.phases.get(name) == PodStatus.RUNNING
+                ]
+            for name, proc in snapshot:
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                phase = (
+                    PodStatus.SUCCEEDED if rc == 0 else PodStatus.FAILED
+                )
+                with self._lock:
+                    # delete_pod may have won the race; keep its verdict.
+                    if self.phases.get(name) != PodStatus.RUNNING:
+                        continue
+                    self.phases[name] = phase
+                self._emit(name, phase)
+            _time.sleep(0.1)
+
+    def _emit(self, name: str, phase: str, address: str = ""):
+        if self._callback is not None:
+            self._callback(name, phase, address)
 
 
 class K8sClient(AbstractK8sClient):
@@ -214,7 +356,9 @@ class K8sClient(AbstractK8sClient):
                     phase = pod.status.phase
                     if event["type"] == "DELETED":
                         phase = PodStatus.DELETED
-                    self._callback(pod.metadata.name, phase)
+                    self._callback(
+                        pod.metadata.name, phase, pod.status.pod_ip or ""
+                    )
             except Exception as exc:
                 logger.warning(
                     "k8s watch reconnecting in %.0fs after: %s", backoff, exc

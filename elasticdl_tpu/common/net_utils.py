@@ -1,0 +1,42 @@
+"""Self-address discovery for worker pods.
+
+Parity role: the reference's AllReduce workers were addressed by the
+Horovod rendezvous via host:port entries the master collected from k8s
+(SURVEY.md C6/§3.4).  Here every worker must be reachable as a
+`jax.distributed` peer, and rank 0's address doubles as the coordination
+service address, so a worker needs to know the address other hosts can
+dial it on — NOT `localhost`.
+
+Resolution order: explicit env (k8s downward-API pod IP) > the source
+address the kernel picks to reach the master (a UDP connect performs no
+handshake, so this works without any listener) > hostname lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+from elasticdl_tpu.common.constants import WorkerEnv
+
+
+def get_reachable_address(master_addr: str = "") -> str:
+    explicit = os.environ.get(WorkerEnv.WORKER_ADDR) or os.environ.get(
+        "POD_IP"
+    )
+    if explicit:
+        return explicit
+    host = (master_addr or "").rsplit(":", 1)[0] or "8.8.8.8"
+    try:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.connect((host, 9))
+            return sock.getsockname()[0]
+        finally:
+            sock.close()
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"

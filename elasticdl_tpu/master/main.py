@@ -88,19 +88,35 @@ class Master:
         # evaluate-only jobs: the eval round IS the job — inject upfront.
         if self.job_type == "evaluate" and evaluation_shards:
             self.task_manager.create_evaluation_tasks(model_version=0)
+        eval_summary = None
+        if getattr(args, "tensorboard_log_dir", ""):
+            import os
+
+            from elasticdl_tpu.common.summary import SummaryWriter
+
+            eval_summary = SummaryWriter(
+                os.path.join(args.tensorboard_log_dir, "master")
+            )
         self.evaluation_service = EvaluationService(
             self.task_manager,
             evaluation_steps=args.evaluation_steps,
             start_delay_secs=args.evaluation_start_delay_secs,
             throttle_secs=args.evaluation_throttle_secs,
+            summary_writer=eval_summary,
         )
         self.rendezvous_server = None
         self.pod_manager = None
+        self.recovery_clock = None
+        self._k8s = k8s_client
         if k8s_client is not None:
             from elasticdl_tpu.master.pod_manager import PodManager
+            from elasticdl_tpu.master.recovery import RecoveryClock
             from elasticdl_tpu.master.rendezvous_server import RendezvousServer
 
-            self.rendezvous_server = RendezvousServer()
+            self.recovery_clock = RecoveryClock()
+            self.rendezvous_server = RendezvousServer(
+                coordinator_port=getattr(args, "coordinator_port", 51001)
+            )
             self.pod_manager = PodManager(
                 k8s_client,
                 task_manager=self.task_manager,
@@ -117,11 +133,13 @@ class Master:
                 ),
                 priority_class=getattr(args, "worker_pod_priority", ""),
                 on_job_abort=self._on_job_abort,
+                recovery_clock=self.recovery_clock,
             )
         self.servicer = MasterServicer(
             self.task_manager,
             evaluation_service=self.evaluation_service,
             rendezvous_server=self.rendezvous_server,
+            recovery_clock=self.recovery_clock,
         )
         self._grpc_server = None
         self._done = threading.Event()
@@ -167,12 +185,18 @@ class Master:
             filter_args={"job_type", "worker_id", "master_addr", "func"},
         )
         port = self.bound_port if self.bound_port else self.args.port
+        master_host = (
+            self._k8s.master_host(self.args.job_name)
+            if self._k8s is not None
+            else f"{self.args.job_name}-master"
+        )
+        import sys
+
         return (
-            ["python", "-m", "elasticdl_tpu.worker.main"]
+            [sys.executable, "-m", "elasticdl_tpu.worker.main"]
             + worker_args
             + [
-                "--master_addr",
-                f"{self.args.job_name}-master:{port}",
+                "--master_addr", f"{master_host}:{port}",
                 "--worker_id", str(worker_id),
                 "--job_type", self.job_type,
             ]
@@ -233,7 +257,11 @@ class Master:
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
+        from elasticdl_tpu.common.constants import KEEP_ALIVE_INTERVAL_S
+
         deadline = None if timeout is None else time.time() + timeout
+        stale_after = 3 * KEEP_ALIVE_INTERVAL_S
+        next_stale_check = time.time() + stale_after
         while True:
             remaining = None if deadline is None else deadline - time.time()
             if remaining is not None and remaining <= 0:
@@ -243,6 +271,16 @@ class Master:
                     return False
                 if self.task_manager.finished:
                     return True
+            if self.pod_manager is not None and time.time() > next_stale_check:
+                next_stale_check = time.time() + stale_after
+                stale = self.servicer.stale_workers(stale_after)
+                if stale:
+                    logger.warning(
+                        "Workers silent > %.0fs (lease reaper will recover "
+                        "their tasks): %s",
+                        stale_after,
+                        {w: round(s, 1) for w, s in stale.items()},
+                    )
 
     def stop(self):
         if self.pod_manager is not None:
@@ -258,7 +296,11 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
     `k8s_client` directly."""
     args = args_lib.parse_master_args(argv)
     if k8s_client is None and args.distribution_strategy != "Local":
-        if args.use_fake_k8s:
+        if args.use_process_k8s:
+            from elasticdl_tpu.common.k8s_client import ProcessK8sClient
+
+            k8s_client = ProcessK8sClient()
+        elif args.use_fake_k8s:
             from elasticdl_tpu.common.k8s_client import FakeK8sClient
 
             k8s_client = FakeK8sClient()
@@ -272,6 +314,11 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
     master.start()
     ok = master.wait()
     logger.info("Job complete: %s", master.task_manager.snapshot())
+    if master.recovery_clock is not None and master.recovery_clock.history:
+        logger.info(
+            "Elastic recoveries this job: %s",
+            [round(s, 2) for s in master.recovery_clock.history],
+        )
     metrics = master.evaluation_service.latest_metrics()
     if metrics:
         logger.info("Final metrics: %s", metrics)

@@ -34,6 +34,7 @@ class PodManager:
         worker_resources: Optional[Dict[str, str]] = None,
         priority_class: str = "",
         on_job_abort=None,
+        recovery_clock=None,
     ):
         self._k8s = k8s_client
         self._tm = task_manager
@@ -48,6 +49,7 @@ class PodManager:
         # Fired when the last worker dies with its relaunch chain exhausted
         # — without it a fully-crashed job would hang the master forever.
         self._on_job_abort = on_job_abort or (lambda reason: None)
+        self._recovery_clock = recovery_clock
 
         self._lock = threading.Lock()
         self._next_worker_id = 0
@@ -109,30 +111,40 @@ class PodManager:
         pod_name = f"{self._job_name}-worker-{worker_id}"
         self._pod_by_worker[worker_id] = pod_name
         self._worker_by_pod[pod_name] = worker_id
+        if self._rendezvous is not None:
+            self._rendezvous.set_expected(len(self._pod_by_worker))
         return pod_name
 
     # ---- event handling ------------------------------------------------
 
-    def _event_cb(self, pod_name: str, phase: str):
+    def _event_cb(self, pod_name: str, phase: str, address: str = ""):
         worker_id = self._worker_by_pod.get(pod_name)
         if worker_id is None:
             return
         prev = self._phases.get(pod_name)
         self._phases[pod_name] = phase
-        if phase == prev:
+        # Repeated RUNNING events are NOT deduped: real k8s assigns
+        # pod.status.pod_ip after the first Running event, and add_worker
+        # is idempotent on (worker_id, address) anyway.
+        if phase == prev and phase != PodStatus.RUNNING:
             return
-        logger.info("Pod %s: %s -> %s", pod_name, prev, phase)
+        if phase != prev:
+            logger.info("Pod %s: %s -> %s", pod_name, prev, phase)
         if phase == PodStatus.RUNNING:
             if self._rendezvous is not None:
-                self._rendezvous.add_worker(worker_id)
+                self._rendezvous.add_worker(worker_id, address)
         elif phase in (PodStatus.FAILED, PodStatus.DELETED):
             self._on_worker_lost(worker_id, pod_name, phase)
         elif phase == PodStatus.SUCCEEDED:
             with self._lock:
                 self._pod_by_worker.pop(worker_id, None)
                 self._worker_by_pod.pop(pod_name, None)
+                if self._rendezvous is not None:
+                    self._rendezvous.set_expected(len(self._pod_by_worker))
 
     def _on_worker_lost(self, worker_id: int, pod_name: str, phase: str):
+        if self._recovery_clock is not None and not self.stopped:
+            self._recovery_clock.mark_loss()
         # 1. failure detector -> task lease recovery (at-least-once)
         if self._tm is not None:
             self._tm.recover_tasks(worker_id)
@@ -142,6 +154,11 @@ class PodManager:
         with self._lock:
             self._pod_by_worker.pop(worker_id, None)
             self._worker_by_pod.pop(pod_name, None)
+            if self._rendezvous is not None:
+                # Transiently lower until a relaunch re-registers; if the
+                # chain is exhausted this IS the new target, so waiting
+                # workers don't deadlock on a world size that cannot come.
+                self._rendezvous.set_expected(len(self._pod_by_worker))
         # 3. relaunch within budget (FAILED only: DELETED = intentional).
         # The budget is tracked per replacement CHAIN: a replacement pod
         # inherits the failure count of the worker it replaces, so a

@@ -1,0 +1,50 @@
+"""Master-side recovery-time measurement.
+
+BASELINE.md's headline elasticity metric is `recovery time = preemption
+signal -> first post-restore optimizer step`.  The master is the one place
+that observes both ends without clock skew: the pod manager stamps the
+membership loss, and the servicer stamps the first training progress that
+follows (report_version from the rebuilt group, or a successful task
+report).  Parity note: the reference had no such measurement — SURVEY.md
+§6 requires baselines to be measured, not transcribed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+class RecoveryClock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending_since: Optional[float] = None
+        self.history: List[float] = []
+
+    def mark_loss(self) -> None:
+        """A worker left the membership (preemption/failure/scale event).
+        The earliest pending loss wins so a multi-loss outage is measured
+        end to end."""
+        with self._lock:
+            if self._pending_since is None:
+                self._pending_since = time.time()
+
+    def mark_progress(self) -> Optional[float]:
+        """Training progressed; closes a pending outage and returns its
+        duration in seconds (None when nothing was pending)."""
+        with self._lock:
+            if self._pending_since is None:
+                return None
+            elapsed = time.time() - self._pending_since
+            self._pending_since = None
+            self.history.append(elapsed)
+        logger.info(
+            "elastic recovery: %.2fs (worker loss -> first post-restore "
+            "training progress)", elapsed,
+        )
+        return elapsed

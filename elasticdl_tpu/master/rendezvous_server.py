@@ -27,12 +27,24 @@ class RendezvousServer:
         self._workers: Dict[int, str] = {}  # worker_id -> address
         self._rendezvous_id = 0
         self._coordinator_port = coordinator_port
+        # The pod manager's membership target for the current epoch (how
+        # many workers it intends to be alive).  0 = unknown/not managed.
+        self._expected = 0
+        # worker_id -> last epoch the worker's MAIN thread confirmed
+        # readiness for.  The confirmation barrier: a mesh only forms once
+        # every member confirmed the current epoch, so wedged ranks (which
+        # cannot confirm) never get peers dialing their dead coordinator.
+        self._confirmed: Dict[int, int] = {}
 
     # ---- membership (driven by the pod manager) ------------------------
 
     def add_worker(self, worker_id: int, address: str = "") -> int:
         with self._lock:
-            if self._workers.get(worker_id) == address:
+            if worker_id in self._workers and (
+                self._workers[worker_id] == address or not address
+            ):
+                # Idempotent re-add; an empty re-report never clobbers a
+                # known-good address.
                 return self._rendezvous_id
             self._workers[worker_id] = address
             self._rendezvous_id += 1
@@ -42,11 +54,36 @@ class RendezvousServer:
             )
             return self._rendezvous_id
 
+    def update_address(self, worker_id: int, address: str) -> int:
+        """Worker self-report (keep_alive): correct the stored address when
+        the k8s watch delivered RUNNING before the pod IP was assigned.
+        Only existing members update — a stale keep_alive from a removed
+        worker must not resurrect it.  An address change bumps the epoch:
+        rank assignment is stable but the coordinator address may move."""
+        with self._lock:
+            if not address or worker_id not in self._workers:
+                return self._rendezvous_id
+            if self._workers[worker_id] == address:
+                return self._rendezvous_id
+            self._workers[worker_id] = address
+            self._rendezvous_id += 1
+            logger.info(
+                "Rendezvous %d: worker %d address -> %s",
+                self._rendezvous_id, worker_id, address,
+            )
+            return self._rendezvous_id
+
+    def set_expected(self, n: int) -> None:
+        """Pod manager publishes its membership target for this epoch."""
+        with self._lock:
+            self._expected = n
+
     def remove_worker(self, worker_id: int) -> int:
         with self._lock:
             if worker_id not in self._workers:
                 return self._rendezvous_id
             del self._workers[worker_id]
+            self._confirmed.pop(worker_id, None)
             self._rendezvous_id += 1
             logger.info(
                 "Rendezvous %d: -worker %d (%d left)",
@@ -60,9 +97,21 @@ class RendezvousServer:
         self, req: Optional[pb.GetClusterSpecRequest] = None
     ) -> pb.ClusterSpec:
         with self._lock:
+            if (
+                req is not None
+                and req.confirm_epoch
+                and req.worker_id in self._workers
+            ):
+                self._confirmed[req.worker_id] = req.confirm_epoch
+            all_confirmed = bool(self._workers) and all(
+                self._confirmed.get(wid) == self._rendezvous_id
+                for wid in self._workers
+            )
             spec = pb.ClusterSpec(
                 rendezvous_id=self._rendezvous_id,
                 world_size=len(self._workers),
+                expected_world_size=self._expected,
+                all_confirmed=all_confirmed,
             )
             ordered = sorted(self._workers)
             for rank, worker_id in enumerate(ordered):

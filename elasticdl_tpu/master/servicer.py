@@ -23,6 +23,7 @@ class MasterServicer:
         task_manager: TaskManager,
         evaluation_service=None,
         rendezvous_server=None,
+        recovery_clock=None,
     ):
         from elasticdl_tpu.master.spmd_assigner import SpmdAssigner
 
@@ -32,6 +33,7 @@ class MasterServicer:
         self._spmd = SpmdAssigner(task_manager, rendezvous_server)
         self._worker_liveness = {}
         self._max_model_version = 0
+        self._recovery_clock = recovery_clock
 
     # ---- task dispatch -------------------------------------------------
 
@@ -54,6 +56,8 @@ class MasterServicer:
         return self._spmd.get(req)
 
     def report_task_result(self, req: pb.ReportTaskResultRequest, ctx):
+        if self._recovery_clock is not None and req.err_message == "":
+            self._recovery_clock.mark_progress()
         self._tm.report(
             req.task_id,
             success=(req.err_message == ""),
@@ -73,6 +77,8 @@ class MasterServicer:
         return pb.Empty()
 
     def report_version(self, req: pb.ReportVersionRequest, ctx):
+        if self._recovery_clock is not None:
+            self._recovery_clock.mark_progress()
         self._max_model_version = max(
             self._max_model_version, req.model_version
         )
@@ -89,6 +95,11 @@ class MasterServicer:
 
     def keep_alive(self, req: pb.KeepAliveRequest, ctx):
         self._worker_liveness[req.worker_id] = time.time()
+        if req.address and self._rendezvous is not None:
+            # Self-reported pod IP: corrects the watch-delivered address
+            # when RUNNING arrived before the IP was assigned, so the JAX
+            # coordinator never falls back to localhost on multi-host.
+            self._rendezvous.update_address(req.worker_id, req.address)
         return pb.Empty()
 
     # ---- introspection -------------------------------------------------
@@ -99,3 +110,17 @@ class MasterServicer:
 
     def worker_last_seen(self, worker_id: int) -> Optional[float]:
         return self._worker_liveness.get(worker_id)
+
+    def stale_workers(self, threshold_s: float) -> dict:
+        """worker_id -> seconds-silent for workers whose last keep_alive is
+        older than `threshold_s`.  The task-lease reaper remains the actual
+        hang detector; this is the observability surface the master logs."""
+        now = time.time()
+        # Snapshot first: keep_alive inserts new keys from gRPC threads,
+        # and iterating the live dict would raise "changed size during
+        # iteration" exactly when relaunched workers check in.
+        return {
+            wid: now - seen
+            for wid, seen in list(self._worker_liveness.items())
+            if now - seen > threshold_s
+        }

@@ -1,29 +1,34 @@
-"""Collective-ops surface.
+"""Collective operations above what XLA emits automatically.
 
 Parity: reference python/collective_ops/ + Horovod wrapper (SURVEY.md C15).
-On TPU these are XLA collectives over ICI/DCN; inside `jit` they are
-emitted automatically from shardings, and inside `shard_map` they are the
-explicit `jax.lax` primitives re-exported here.  This module exists so
-framework code has ONE place naming the communication vocabulary; there is
-deliberately no hand-rolled ring — XLA owns scheduling and fusion.
+On TPU, device-level collectives are XLA's job: inside `jit` they are
+emitted from shardings, and inside `shard_map` code uses the `jax.lax`
+primitives directly.  What remains for a framework module is the
+cross-HOST layer (process-level gathers for host-side metrics/output) and
+the named patterns the reference's Horovod wrapper provided (gradient
+allreduce, broadcast-on-init).  There is deliberately no hand-rolled ring
+— XLA owns scheduling and fusion.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from elasticdl_tpu.parallel.mesh import DATA_AXIS
 
-# explicit collectives for shard_map code
-psum = jax.lax.psum
-pmean = jax.lax.pmean
-pmax = jax.lax.pmax
-pmin = jax.lax.pmin
-all_gather = jax.lax.all_gather
-ppermute = jax.lax.ppermute
-all_to_all = jax.lax.all_to_all
-axis_index = jax.lax.axis_index
+
+def host_allgather(x) -> np.ndarray:
+    """Gather a (possibly data-sharded) array fully onto EVERY host as a
+    numpy value.  Used where device results must reach host-side code that
+    needs all rows — metric fns (AUC over the whole eval shard) and
+    prediction output (worker/spmd.py).  No-op in single-process mode."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)
 
 
 def allreduce_mean_gradients(grads, axis_name: str = DATA_AXIS):

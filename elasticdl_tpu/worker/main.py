@@ -37,6 +37,53 @@ def build_master_client(addr: str):
     return MasterStub(channel)
 
 
+def start_keep_alive(client, worker_id: int, master_addr: str) -> str:
+    """Self-report this worker's reachable address immediately, then keep
+    reporting liveness on a daemon thread.  The address report closes the
+    real-k8s gap where the watch delivers RUNNING before the pod IP is
+    assigned (the coordinator address must never fall back to localhost on
+    multi-host)."""
+    import threading
+    import time
+
+    from elasticdl_tpu.common.constants import KEEP_ALIVE_INTERVAL_S
+    from elasticdl_tpu.common.net_utils import get_reachable_address
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    address = get_reachable_address(master_addr)
+
+    def beat():
+        try:
+            client.keep_alive(
+                pb.KeepAliveRequest(
+                    worker_id=worker_id,
+                    timestamp_ms=int(time.time() * 1000),
+                    address=address,
+                )
+            )
+        except Exception:
+            pass  # master briefly unreachable; liveness is best-effort
+
+    beat()
+
+    def loop():
+        while True:
+            time.sleep(KEEP_ALIVE_INTERVAL_S)
+            beat()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return address
+
+
+def wait_for_membership(client, worker_id: int, poll_s: float = 0.5):
+    """Block until this worker appears in a settled, group-confirmed
+    cluster spec (see elasticdl_tpu.worker.spmd.wait_for_confirmed_epoch).
+    """
+    from elasticdl_tpu.worker.spmd import wait_for_confirmed_epoch
+
+    return wait_for_confirmed_epoch(client, worker_id, poll_s=poll_s)
+
+
 def main(argv=None):
     args = args_lib.parse_worker_args(argv)
     worker_id = int(
@@ -58,36 +105,43 @@ def main(argv=None):
     else:
         reader = create_data_reader(args.training_data)
 
-    from elasticdl_tpu.common.save_utils import CheckpointSaver
     from elasticdl_tpu.worker.worker import Worker
 
-    saver = None
+    saver_factory = None
     if args.checkpoint_dir:
-        saver = CheckpointSaver(
-            args.checkpoint_dir, keep_max=args.keep_checkpoint_max
-        )
+        # NOT constructed here: Orbax touches the XLA backend, and in
+        # cluster mode jax.distributed.initialize must run first (the
+        # SPMDWorker calls the factory inside setup()).
+        def saver_factory():
+            from elasticdl_tpu.common.save_utils import CheckpointSaver
+
+            return CheckpointSaver(
+                args.checkpoint_dir, keep_max=args.keep_checkpoint_max
+            )
+
+    tb_dir = (
+        os.path.join(args.tensorboard_log_dir, f"worker-{worker_id}")
+        if args.tensorboard_log_dir
+        else ""
+    )
 
     if args.distribution_strategy != "Local" and args.num_workers > 1:
         # Cluster SPMD: all worker processes form ONE global mesh and run
         # the same collective step — there is one model by construction
         # (worker/spmd.py).  Rank/topology comes from the master's
-        # rendezvous; wait until this worker is a member.
-        import time
-
+        # rendezvous; wait until this worker is a member of a settled
+        # epoch.
         from elasticdl_tpu.proto import elasticdl_pb2 as pb
         from elasticdl_tpu.worker.spmd import SPMDWorker
 
-        while True:
-            cluster = client.get_cluster_spec(
-                pb.GetClusterSpecRequest(worker_id=worker_id)
-            )
-            me = next(
-                (w for w in cluster.workers if w.worker_id == worker_id),
-                None,
-            )
-            if me is not None and cluster.world_size == args.num_workers:
-                break
-            time.sleep(1.0)
+        my_addr = start_keep_alive(client, worker_id, master_addr)
+        cluster, me = wait_for_membership(client, worker_id)
+        logger.info(
+            "Worker %d joined epoch %d as rank %d/%d (addr=%s, "
+            "coordinator=%s)",
+            worker_id, cluster.rendezvous_id, me.rank, cluster.world_size,
+            my_addr, cluster.coordinator_address,
+        )
         worker = SPMDWorker(
             worker_id=worker_id,
             master_client=client,
@@ -98,9 +152,12 @@ def main(argv=None):
             num_processes=cluster.world_size,
             coordinator_address=cluster.coordinator_address,
             use_bf16=args.use_bf16,
-            checkpoint_saver=saver,
+            checkpoint_saver_factory=saver_factory,
             checkpoint_steps=args.checkpoint_steps,
             initial_epoch=cluster.rendezvous_id,
+            output_dir=getattr(args, "output", ""),
+            wedge_grace_s=args.wedge_grace_s,
+            tensorboard_dir=tb_dir,
         )
     else:
         worker = Worker(
@@ -110,10 +167,11 @@ def main(argv=None):
             spec=spec,
             minibatch_size=args.minibatch_size,
             use_bf16=args.use_bf16,
-            checkpoint_saver=saver,
+            checkpoint_saver=saver_factory() if saver_factory else None,
             checkpoint_steps=args.checkpoint_steps,
+            tensorboard_dir=tb_dir,
         )
-    if saver is not None:
+    if saver_factory is not None:
         # Preemptible VMs: SIGTERM arrives with a grace window — flush one
         # final synchronous checkpoint so the next topology restores from
         # the last step, not the last periodic save (SURVEY.md §5).

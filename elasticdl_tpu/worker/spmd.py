@@ -28,6 +28,8 @@ step-exact replay is needed (SURVEY.md §7 hard part 1).
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Optional
 
@@ -42,6 +44,61 @@ from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import Trainer
 
 logger = get_logger(__name__)
+
+
+def wait_for_confirmed_epoch(
+    client,
+    worker_id: int,
+    poll_s: float = 0.5,
+    timeout_s: Optional[float] = None,
+):
+    """Block until this worker is a member of a SETTLED and GROUP-CONFIRMED
+    epoch; returns (cluster_spec, my_worker_spec), or (None, None) on
+    timeout.
+
+    Three gates, in order:
+    1. membership — I appear in the spec;
+    2. settled — world_size matches the pod manager's published target
+       (expected_world_size), NOT the static --num_workers flag (which
+       would deadlock replacements after scale-down/budget exhaustion);
+       with no published target (unmanaged rendezvous), any nonzero world
+       counts as settled;
+    3. confirmed — every member's MAIN thread has confirmed this exact
+       epoch.  This is the anti-cascade barrier: a rank wedged in a
+       collective with a dead peer cannot confirm, so nobody initializes
+       a mesh containing it; its watchdog restarts it, the epoch moves,
+       and the survivors re-confirm the new epoch.  Without the barrier,
+       staggered deaths bump the epoch faster than replacements can boot
+       and every joiner suicides on arrival (observed live in
+       tests/test_elastic_cluster.py's first iterations).
+    """
+    import time as _time
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+    deadline = None if timeout_s is None else _time.time() + timeout_s
+    confirm = 0
+    while True:
+        spec = client.get_cluster_spec(
+            pb.GetClusterSpecRequest(
+                worker_id=worker_id, confirm_epoch=confirm
+            )
+        )
+        me = next(
+            (w for w in spec.workers if w.worker_id == worker_id), None
+        )
+        settled = me is not None and (
+            spec.world_size == spec.expected_world_size
+            or (spec.expected_world_size == 0 and spec.world_size > 0)
+        )
+        if settled and spec.all_confirmed and confirm == spec.rendezvous_id:
+            return spec, me
+        # (re-)confirm whatever epoch we currently observe; recorded on
+        # the NEXT poll
+        confirm = spec.rendezvous_id if settled else 0
+        if deadline is not None and _time.time() > deadline:
+            return None, None
+        _time.sleep(poll_s)
 
 
 class SPMDWorker:
@@ -60,9 +117,13 @@ class SPMDWorker:
         use_bf16: bool = False,
         seed: int = 0,
         checkpoint_saver=None,
+        checkpoint_saver_factory=None,
         checkpoint_steps: int = 0,
         wait_sleep_s: float = 0.2,
         initial_epoch: int = 0,
+        wedge_grace_s: float = 20.0,
+        output_dir: str = "",
+        tensorboard_dir: str = "",
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -78,6 +139,10 @@ class SPMDWorker:
         self._use_bf16 = use_bf16
         self._seed = seed
         self._saver = checkpoint_saver
+        # Orbax construction touches the XLA backend, which must not
+        # happen before jax.distributed.initialize — multi-process callers
+        # pass a FACTORY and the saver is built in setup(), after init.
+        self._saver_factory = checkpoint_saver_factory
         self._checkpoint_steps = checkpoint_steps
         self._wait_sleep_s = wait_sleep_s
         self._epoch = initial_epoch
@@ -86,17 +151,52 @@ class SPMDWorker:
         self.mesh = None
         self.last_loss = None
         self.remesh_count = 0
+        self._preempted = False
+        self._output_dir = output_dir
+        self._recovery_t0: Optional[float] = None
+        self._wedge_grace_s = wedge_grace_s
+        self._epoch_stale_since: Optional[float] = None
+        self._watchdog_started = False
+        # Set while the MAIN thread is in the confirmation-barrier poll
+        # loop: it is then provably live and epoch-aware, so the watchdog
+        # must not shoot it for lagging the epoch.
+        self._in_rendezvous_wait = False
+        # Leader-only observability: ONE rank writes scalars (every rank
+        # holds identical state/loss by construction).
+        from elasticdl_tpu.common.profiler import StepTimer
+        from elasticdl_tpu.common.summary import SummaryWriter
+
+        self.step_timer = StepTimer()
+        self._summary = SummaryWriter(
+            tensorboard_dir if (tensorboard_dir and process_id == 0) else None
+        )
 
     # ---- runtime lifecycle --------------------------------------------
 
+    # jax.distributed.initialize's default 300s join deadline is far too
+    # long for an elastic group: a rank that entered initialize with a
+    # stale epoch would anchor the whole recovery cascade on it.  The
+    # watchdog (started BEFORE initialize) normally restarts such a rank
+    # within the grace window; this cap is the backstop.
+    INIT_TIMEOUT_S = 60
+
     def setup(self) -> None:
         """Join the distributed runtime and build the global mesh."""
+        if self.num_processes > 1 and not self._watchdog_started:
+            # Must start before initialize(): a rank blocked in
+            # RegisterTask against a coordinator of a newer epoch can only
+            # be saved by the watchdog restarting the process.
+            self._watchdog_started = True
+            threading.Thread(target=self._watchdog, daemon=True).start()
         if self.num_processes > 1 and not jax.distributed.is_initialized():
             jax.distributed.initialize(
                 coordinator_address=self._coordinator,
                 num_processes=self.num_processes,
                 process_id=self.process_id,
+                initialization_timeout=self.INIT_TIMEOUT_S,
             )
+        if self._saver is None and self._saver_factory is not None:
+            self._saver = self._saver_factory()
         self.mesh = mesh_lib.create_mesh(jax.devices())
         self.trainer = Trainer(
             model=self.spec.model,
@@ -131,6 +231,47 @@ class SPMDWorker:
     def is_leader(self) -> bool:
         return self.process_id == 0
 
+    # ---- wedge watchdog --------------------------------------------------
+    # A dead peer does NOT fail a blocking XLA collective — the survivor
+    # hangs in it forever (measured: gloo psum blocks >75s after peer
+    # death; on a real TPU slice the ICI collective stalls the same way —
+    # SURVEY.md §7 hard part 3).  The in-process re-rendezvous path only
+    # runs BETWEEN tasks, so a rank stuck INSIDE a collective when the
+    # membership epoch moves must be restarted: the watchdog polls the
+    # master and, if the epoch has moved past us for longer than the grace
+    # window (i.e. the main loop never reached the stale-epoch check),
+    # kills the process.  The pod manager relaunches it; the replacement
+    # bootstraps at the new epoch and restores from the checkpoint — the
+    # restart unit is the process, exactly like a slice-host loss.
+
+    WEDGED_EXIT_CODE = 43
+
+    def _watchdog(self, poll_s: float = 2.0) -> None:
+        while True:
+            time.sleep(poll_s)
+            try:
+                spec = self._client.get_cluster_spec(
+                    pb.GetClusterSpecRequest(worker_id=self.worker_id)
+                )
+            except Exception:
+                continue  # master briefly unreachable
+            if spec.rendezvous_id <= self._epoch or self._in_rendezvous_wait:
+                self._epoch_stale_since = None
+                continue
+            now = time.time()
+            if self._epoch_stale_since is None:
+                self._epoch_stale_since = now
+                continue
+            if now - self._epoch_stale_since > self._wedge_grace_s:
+                logger.error(
+                    "Rank %d wedged: epoch moved %d -> %d but the main "
+                    "loop hasn't re-rendezvoused in %.0fs (stuck in a "
+                    "collective with a dead peer); restarting process",
+                    self.process_id, self._epoch, spec.rendezvous_id,
+                    now - self._epoch_stale_since,
+                )
+                os._exit(self.WEDGED_EXIT_CODE)
+
     # ---- main loop -----------------------------------------------------
 
     def run(self) -> bool:
@@ -138,6 +279,13 @@ class SPMDWorker:
             self.setup()
         seq = 0
         while True:
+            if self._preempted:
+                logger.info(
+                    "Rank %d stopping at task boundary (SIGTERM); tasks "
+                    "re-lease and the relaunch restores from checkpoint",
+                    self.process_id,
+                )
+                return False
             try:
                 resp = self._client.get_spmd_task(
                     pb.GetSpmdTaskRequest(
@@ -154,6 +302,13 @@ class SPMDWorker:
                 logger.info(
                     "Job finished; SPMD rank %d exiting", self.process_id
                 )
+                self._flush_predictions()
+                if self.is_leader and self.step_timer.steps_per_sec:
+                    self.step_timer.log(f"rank {self.process_id}: ")
+                self._summary.close()
+                from elasticdl_tpu.worker.worker import invoke_callbacks
+
+                invoke_callbacks(self.spec.callbacks, "on_job_end")
                 return True
             if resp.epoch_stale:
                 logger.info(
@@ -171,10 +326,14 @@ class SPMDWorker:
             self._process_task(task)
             seq += 1
 
-    def _process_task(self, task: pb.Task) -> None:
+    def _process_task(self, task: pb.Task) -> int:
         # No per-rank failure reporting: if any rank's collective step
         # dies the whole group is wedged and recovery is the elastic
         # epoch-bump path, not a task retry.
+        from elasticdl_tpu.worker.worker import invoke_callbacks
+
+        invoke_callbacks(self.spec.callbacks, "on_task_start", task)
+        records = 0
         if task.type == pb.TRAINING:
             records = self._train_task(task)
             if self.is_leader:
@@ -193,17 +352,19 @@ class SPMDWorker:
                 # Same guard as Worker._evaluate_task: never report metrics
                 # from randomly initialised params.  The condition is
                 # deterministic across ranks (state/step identical), so all
-                # ranks skip together; the leader re-queues the task.
+                # ranks skip together; the leader re-queues the task.  No
+                # early return: on_task_end must pair with the
+                # on_task_start already fired above.
                 if self.is_leader:
                     self._data_service.report_task(
                         task,
                         err="no trained state for evaluation",
                         transient=True,
                     )
-                return
-            records = self._evaluate_task(task)
-            if self.is_leader:
-                self._data_service.report_task(task, records=records)
+            else:
+                records = self._evaluate_task(task)
+                if self.is_leader:
+                    self._data_service.report_task(task, records=records)
         elif task.type == pb.PREDICTION:
             records = self._predict_task(task)
             if self.is_leader:
@@ -227,6 +388,8 @@ class SPMDWorker:
             logger.warning("SPMD worker ignoring task type %s", task.type)
             if self.is_leader:
                 self._data_service.report_task(task, records=0)
+        invoke_callbacks(self.spec.callbacks, "on_task_end", task, records)
+        return records
 
     def _train_task(self, task: pb.Task) -> int:
         records = 0
@@ -239,8 +402,28 @@ class SPMDWorker:
                 self.state, global_batch
             )
             self.last_loss = loss
+            if self._recovery_t0 is not None:
+                # BASELINE.md's headline elasticity metric: preemption
+                # (epoch bump observed) -> first post-restore optimizer
+                # step.
+                logger.info(
+                    "elastic recovery: %.2fs (epoch %d, world %d, "
+                    "resumed at step %d)",
+                    time.time() - self._recovery_t0, self._epoch,
+                    self.num_processes, int(self.state.step),
+                )
+                self._recovery_t0 = None
+            self.step_timer.tick()
             records += real
             self._maybe_checkpoint()
+        if self.last_loss is not None:
+            self._summary.scalars(
+                {
+                    "train/loss": float(np.asarray(self.last_loss)),
+                    "train/steps_per_sec": self.step_timer.steps_per_sec,
+                },
+                step=int(self.state.step),
+            )
         return records
 
     def _evaluate_task(self, task: pb.Task) -> int:
@@ -288,7 +471,7 @@ class SPMDWorker:
 
     def _predict_task(self, task: pb.Task) -> int:
         records = 0
-        self.predictions = getattr(self, "predictions", [])
+        rows = []
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
@@ -299,9 +482,46 @@ class SPMDWorker:
             preds = _allgather(
                 self.trainer.predict_on_global_batch(self.state, features)
             )
-            self.predictions.append(np.asarray(preds)[:real])
+            rows.append(np.asarray(preds)[:real])
             records += real
+        if rows:
+            # Keyed by task_id so a task re-processed after a remesh (the
+            # lease was recovered before the leader reported) OVERWRITES
+            # its rows instead of duplicating them; with an output dir the
+            # leader also makes each task's rows durable immediately, so
+            # rows reported before a process restart are never lost.
+            self.predictions = getattr(self, "predictions", {})
+            self.predictions[task.task_id] = np.concatenate(rows)
+            if self.is_leader and self._output_dir:
+                os.makedirs(self._output_dir, exist_ok=True)
+                np.save(
+                    os.path.join(
+                        self._output_dir, f"part-{task.task_id:05d}.npy"
+                    ),
+                    self.predictions[task.task_id],
+                )
         return records
+
+    def _flush_predictions(self) -> None:
+        """Cluster predict jobs: assemble the per-task part files (written
+        durably as each task completed) into one predictions.npy — the
+        same final artifact local mode produces (client/api.py)."""
+        if not self.is_leader or not self._output_dir:
+            return
+        import glob
+
+        parts = sorted(
+            glob.glob(os.path.join(self._output_dir, "part-*.npy"))
+        )
+        if not parts:
+            return
+        merged = np.concatenate([np.load(p) for p in parts])
+        np.save(os.path.join(self._output_dir, "predictions.npy"), merged)
+        logger.info(
+            "Merged %d prediction part files (%d rows) into %s",
+            len(parts), len(merged),
+            os.path.join(self._output_dir, "predictions.npy"),
+        )
 
     def _has_trained_state(self) -> bool:
         if self.state is not None and int(self.state.step) > 0:
@@ -313,17 +533,31 @@ class SPMDWorker:
 
     # ---- elasticity ----------------------------------------------------
 
-    def _re_rendezvous(self) -> bool:
+    def _re_rendezvous(self, settle_timeout_s: float = 60.0) -> bool:
         """Membership changed: rejoin with the new topology and restore
         state from the latest checkpoint."""
-        spec = self._client.get_cluster_spec(
-            pb.GetClusterSpecRequest(
-                worker_id=self.worker_id, known_rendezvous_id=self._epoch
+        self._recovery_t0 = time.time()
+        # Wait for a settled, group-confirmed epoch (the same barrier as
+        # first join) so we re-init exactly once, for a topology whose
+        # every member is provably alive.  A timeout means the group never
+        # stabilised around us — exit and let the pod manager relaunch a
+        # fresh process that joins cleanly.
+        self._in_rendezvous_wait = True
+        try:
+            spec, me = wait_for_confirmed_epoch(
+                self._client,
+                self.worker_id,
+                poll_s=self._wait_sleep_s,
+                timeout_s=settle_timeout_s,
             )
-        )
-        me = next(
-            (w for w in spec.workers if w.worker_id == self.worker_id), None
-        )
+        finally:
+            self._in_rendezvous_wait = False
+        if spec is None:
+            logger.warning(
+                "Worker %d: no confirmed epoch within %.0fs; restarting",
+                self.worker_id, settle_timeout_s,
+            )
+            return False
         if me is None or spec.world_size == 0:
             logger.warning(
                 "Worker %d evicted at epoch %d; exiting",
@@ -331,21 +565,63 @@ class SPMDWorker:
             )
             return False
         self._epoch = spec.rendezvous_id
+        if self._saver is not None and self._saver_factory is not None:
+            # The saver holds handles into the OLD backend; flush while the
+            # old runtime is still alive, rebuild after re-init.
+            try:
+                self._saver.wait_until_finished()
+                self._saver.close()
+            except Exception:
+                pass
+            self._saver = None
         if jax.distributed.is_initialized():
             jax.distributed.shutdown()
+            # The XLA backend caches the OLD topology; re-initialising at
+            # a new world size requires dropping compiled computations and
+            # the backend itself (verified on the CPU/gloo backend: without
+            # this, initialize() raises "must be called before any JAX
+            # calls").
+            jax.clear_caches()
+            import jax.extend.backend as xb
+
+            xb.clear_backends()
         self.process_id = me.rank
         self.num_processes = spec.world_size
         self._coordinator = spec.coordinator_address or self._coordinator
         self.state = None  # re-init + checkpoint restore on next batch
+        self.trainer = None
         self.setup()
         self.remesh_count += 1
+        logger.info(
+            "Rank %d re-rendezvoused: epoch %d, world %d, coordinator %s "
+            "(%.2fs)",
+            self.process_id, self._epoch, self.num_processes,
+            self._coordinator, time.time() - self._recovery_t0,
+        )
         return True
 
     # ---- helpers -------------------------------------------------------
 
     def save_checkpoint_and_flush(self) -> None:
         """Synchronous final checkpoint (preemption hook: the process is
-        about to die, so wait for the write to land)."""
+        about to die, so wait for the write to land).
+
+        Multi-process mode must NOT save here: the Orbax save is a
+        distributed collective, and SIGTERM reaches ranks at arbitrary
+        points (possibly mid-step, at different state.step values), so a
+        signal-time save can enter mismatched collectives — hanging the
+        grace window or corrupting the checkpoint.  Instead the flag stops
+        the main loop at the next task boundary; recovery rides the
+        periodic checkpoints + task re-lease (the recovery unit is the
+        task, not the step)."""
+        if self.num_processes > 1:
+            self._preempted = True
+            logger.info(
+                "Rank %d preempted; skipping signal-time collective save "
+                "(periodic checkpoints + task re-lease cover recovery)",
+                self.process_id,
+            )
+            return
         self._save(force=True)
         if self._saver is not None:
             self._saver.wait_until_finished()
@@ -369,11 +645,6 @@ class SPMDWorker:
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))
 
 
-def _allgather(x):
-    """Full-array gather onto every host (jax multihost utils; no-op in
-    single-process mode)."""
-    if jax.process_count() == 1:
-        return np.asarray(x)
-    from jax.experimental import multihost_utils
-
-    return multihost_utils.process_allgather(x, tiled=True)
+from elasticdl_tpu.parallel.collectives import (  # noqa: E402
+    host_allgather as _allgather,
+)

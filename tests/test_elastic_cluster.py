@@ -1,0 +1,145 @@
+"""Cross-process elastic remesh cycle (round-2 verdict gap #2).
+
+A live multi-process SPMD group loses a rank MID-JOB — real OS processes,
+real gRPC, real jax.distributed — and the job must still finish:
+
+  kill -9 rank N  ->  pod FAILED  ->  master recovers tasks, bumps the
+  rendezvous epoch, relaunches a replacement pod  ->  the survivor either
+  observes the stale epoch between tasks (in-process shutdown/clear/
+  re-init) or is wedged inside a collective with the dead peer (its
+  watchdog restarts the process)  ->  the rebuilt group restores from the
+  Orbax checkpoint and completes every remaining task.
+
+Covered twice: killing rank 1 (coordinator survives) and killing rank 0
+(the coordinator itself moves to the survivor — the round-2 'unhandled'
+case).  Recovery time (loss -> first post-restore progress) is measured by
+the master's RecoveryClock and asserted present.
+"""
+
+import logging
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common.k8s_client import ProcessK8sClient
+from elasticdl_tpu.master import main as master_main
+from elasticdl_tpu.master.main import Master
+from elasticdl_tpu.common.args import parse_master_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    from model_zoo.mnist.data import write_dataset
+
+    root = tmp_path_factory.mktemp("mnist_elastic_cluster")
+    return write_dataset(str(root), n_train=768, n_val=0)
+
+
+def _run_elastic_job(train_dir, tmp_path, kill_worker_id):
+    """Launch a 2-process cluster job, hard-kill one rank once a
+    checkpoint exists, return (rc, master, k8s, logs, recovery_times)."""
+    port = _free_port()
+    coord_port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    k8s = ProcessK8sClient(
+        extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": REPO,
+        }
+    )
+    argv = [
+        "--training_data", train_dir,
+        "--records_per_task", "64",
+        "--num_epochs", "2",
+        "--num_workers", "2",
+        "--minibatch_size", "32",
+        "--distribution_strategy", "AllReduce",
+        "--port", str(port),
+        "--coordinator_port", str(coord_port),
+        "--job_name", f"elastic-{kill_worker_id}",
+        "--model_zoo", os.path.join(REPO, "model_zoo"),
+        "--model_def", "mnist.mnist_functional_api.custom_model",
+        "--checkpoint_dir", ckpt_dir,
+        "--checkpoint_steps", "2",
+        "--wedge_grace_s", "6",
+        "--task_lease_timeout_s", "60",
+    ]
+    args = parse_master_args(argv)
+    master = Master(args, k8s_client=k8s)
+    master.start()
+    result = {}
+
+    def finish():
+        ok = master.wait(timeout=420)
+        result["rc"] = 0 if ok else 1
+        time.sleep(2.0)  # let workers observe job_finished
+        master.stop()
+
+    fin_thread = threading.Thread(target=finish, daemon=True)
+    fin_thread.start()
+
+    # wait for training progress to be DURABLE — a finalized Orbax step
+    # dir (digit-named), not an in-flight *.orbax-checkpoint-tmp — then
+    # preempt
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        if os.path.isdir(ckpt_dir) and any(
+            name.isdigit() for name in os.listdir(ckpt_dir)
+        ):
+            break
+        time.sleep(0.25)
+    else:
+        k8s.stop()
+        logs = {name: k8s.pod_output(name) for name in list(k8s.pods)}
+        pytest.fail(
+            "no checkpoint ever appeared; cannot test recovery; pod logs:\n"
+            + "\n----\n".join(f"{n}:\n{l}" for n, l in logs.items())
+        )
+    victim = f"elastic-{kill_worker_id}-worker-{kill_worker_id}"
+    kill_time = time.time()
+    k8s.kill_pod(victim)
+
+    fin_thread.join(timeout=420)
+    k8s.stop()
+    logs = {name: k8s.pod_output(name) for name in list(k8s.pods)}
+    return result.get("rc"), master, k8s, logs, kill_time
+
+
+@pytest.mark.parametrize("kill_worker_id", [1, 0])
+def test_elastic_cycle_survives_rank_kill(mnist_data, tmp_path, kill_worker_id):
+    train_dir, _ = mnist_data
+    rc, master, k8s, logs, kill_time = _run_elastic_job(
+        train_dir, tmp_path / f"kill{kill_worker_id}", kill_worker_id
+    )
+    assert rc == 0, (
+        f"job did not survive killing rank {kill_worker_id}; pod logs:\n"
+        + "\n----\n".join(f"{n}:\n{l}" for n, l in logs.items())
+    )
+    # every record of both epochs trained despite the mid-job kill
+    assert master.task_manager.counters.records_done >= 2 * 768
+    # a replacement pod was launched (fresh worker id)
+    worker_specs = [s for s in k8s.create_calls if s.pod_type == "worker"]
+    assert any(s.worker_id >= 2 for s in worker_specs), worker_specs
+    # the headline elasticity metric was measured at the master
+    history = master.recovery_clock.history
+    assert history, "RecoveryClock measured no recovery"
+    print(
+        f"\n[elastic] killed rank {kill_worker_id}; "
+        f"recovery times: {[round(s, 2) for s in history]}s; "
+        f"job wall after kill: {round(time.time() - kill_time, 1)}s"
+    )

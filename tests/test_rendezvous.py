@@ -1,0 +1,88 @@
+"""Rendezvous server semantics: address plumbing, expected-world gating
+and the confirmation barrier that keeps elastic recovery from cascading
+(round-3 additions to SURVEY.md C6)."""
+
+from elasticdl_tpu.master.rendezvous_server import RendezvousServer
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def _spec(rdzv, worker_id=0, confirm=0):
+    return rdzv.cluster_spec(
+        pb.GetClusterSpecRequest(worker_id=worker_id, confirm_epoch=confirm)
+    )
+
+
+def test_addresses_flow_to_spec_and_coordinator():
+    rdzv = RendezvousServer(coordinator_port=5555)
+    rdzv.add_worker(0, "10.0.0.1")
+    rdzv.add_worker(1, "10.0.0.2")
+    spec = _spec(rdzv)
+    assert [w.address for w in spec.workers] == ["10.0.0.1", "10.0.0.2"]
+    assert spec.coordinator_address == "10.0.0.1:5555"  # rank 0's host
+
+
+def test_empty_readd_never_clobbers_known_address():
+    rdzv = RendezvousServer()
+    rdzv.add_worker(0, "10.0.0.1")
+    epoch = rdzv.rendezvous_id
+    rdzv.add_worker(0, "")  # repeated RUNNING event without pod IP
+    assert rdzv.rendezvous_id == epoch
+    assert _spec(rdzv).workers[0].address == "10.0.0.1"
+
+
+def test_update_address_only_for_members_and_bumps_on_change():
+    rdzv = RendezvousServer(coordinator_port=5555)
+    rdzv.add_worker(0, "")
+    epoch = rdzv.rendezvous_id
+    rdzv.update_address(99, "10.9.9.9")  # not a member: ignored
+    assert _spec(rdzv).world_size == 1
+    rdzv.update_address(0, "10.0.0.7")  # late pod-IP self-report
+    assert rdzv.rendezvous_id == epoch + 1
+    assert _spec(rdzv).coordinator_address == "10.0.0.7:5555"
+
+
+def test_expected_world_size_served():
+    rdzv = RendezvousServer()
+    rdzv.add_worker(0)
+    rdzv.set_expected(2)
+    assert _spec(rdzv).expected_world_size == 2
+
+
+def test_confirmation_barrier():
+    rdzv = RendezvousServer()
+    rdzv.add_worker(0, "a")
+    rdzv.add_worker(1, "b")
+    epoch = rdzv.rendezvous_id
+    assert not _spec(rdzv).all_confirmed
+    assert not _spec(rdzv, worker_id=0, confirm=epoch).all_confirmed
+    # second member confirms -> barrier opens in the SAME response
+    assert _spec(rdzv, worker_id=1, confirm=epoch).all_confirmed
+    # any membership change re-arms the barrier
+    rdzv.add_worker(2, "c")
+    new_epoch = rdzv.rendezvous_id
+    assert not _spec(rdzv, worker_id=0, confirm=new_epoch).all_confirmed
+    assert not _spec(rdzv, worker_id=1, confirm=new_epoch).all_confirmed
+    assert _spec(rdzv, worker_id=2, confirm=new_epoch).all_confirmed
+
+
+def test_removed_worker_confirmation_is_forgotten():
+    rdzv = RendezvousServer()
+    rdzv.add_worker(0, "a")
+    rdzv.add_worker(1, "b")
+    epoch = rdzv.rendezvous_id
+    _spec(rdzv, worker_id=0, confirm=epoch)
+    _spec(rdzv, worker_id=1, confirm=epoch)
+    rdzv.remove_worker(1)
+    # worker 0 alone must re-confirm the post-removal epoch
+    spec = _spec(rdzv, worker_id=0)
+    assert not spec.all_confirmed
+    assert _spec(rdzv, worker_id=0, confirm=spec.rendezvous_id).all_confirmed
+
+
+def test_stale_confirmation_does_not_open_barrier():
+    rdzv = RendezvousServer()
+    rdzv.add_worker(0, "a")
+    old = rdzv.rendezvous_id
+    rdzv.add_worker(1, "b")  # bump
+    # worker 0 confirms the OLD epoch: barrier stays closed
+    assert not _spec(rdzv, worker_id=0, confirm=old).all_confirmed
