@@ -38,15 +38,35 @@ def _trainer_for(model_def: str, model_params: str = "", use_bf16=False):
     )
 
 
-def bench_deepfm(batch_size: int = 4096, iters: int = 30):
+def _device_peaks():
+    """Peak numbers for MFU/roofline; None off-TPU (MFU then omitted)."""
     import jax
 
-    spec, trainer = _trainer_for(
-        "deepfm.deepfm_functional_api.custom_model",
-        model_params="vocab_capacity=1048576;embed_dim=16",
-    )
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return {"bf16_flops": 197e12, "hbm_bytes_per_s": 819e9}
+    if "v5p" in kind or "v5" in kind:
+        return {"bf16_flops": 459e12, "hbm_bytes_per_s": 2765e9}
+    if "v4" in kind:
+        return {"bf16_flops": 275e12, "hbm_bytes_per_s": 1228e9}
+    return None
+
+
+def _cost(compiled) -> dict:
+    """flops / bytes-accessed from XLA's own cost model (version-tolerant:
+    dict on new jax, list-of-dict on old)."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return dict(analysis or {})
+
+
+def _make_criteo_batch(batch_size: int):
     rng = np.random.RandomState(0)
-    batch = {
+    return {
         "features": {
             "dense": rng.rand(batch_size, 13).astype(np.float32),
             "sparse": rng.randint(
@@ -55,20 +75,141 @@ def bench_deepfm(batch_size: int = 4096, iters: int = 30):
         },
         "labels": rng.randint(0, 2, batch_size).astype(np.int32),
     }
+
+
+def _deepfm_auc(steps: int = 48, batch_size: int = 4096) -> float:
+    """Short convergence run with planted structure (BASELINE.md: steps/sec
+    only counts *at matching AUC*; this proves the measured step learns)."""
+    import jax
+
+    from model_zoo.common.metrics import auc as auc_fn
+    from model_zoo.deepfm.data import synthetic_criteo
+
+    spec, trainer = _trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        model_params="vocab_capacity=1048576;embed_dim=16;bf16=True;lr=0.005",
+        use_bf16=True,
+    )
+    dense, sparse, labels = synthetic_criteo(steps * batch_size, seed=0)
+    state = trainer.init_state(
+        jax.random.PRNGKey(0),
+        {"dense": dense[:batch_size], "sparse": sparse[:batch_size]},
+    )
+    for i in range(steps):
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        state, _ = trainer.train_on_batch(
+            state,
+            {
+                "features": {"dense": dense[sl], "sparse": sparse[sl]},
+                "labels": labels[sl].astype(np.int32),
+            },
+        )
+    vd, vs, vy = synthetic_criteo(16384, seed=1000)
+    preds = trainer.predict_on_batch(state, {"dense": vd, "sparse": vs})
+    return float(auc_fn(vy, preds))
+
+
+def bench_deepfm(iters: int = 30):
+    """North-star bench (BASELINE.md #4): DeepFM/Criteo sparse stress.
+
+    bf16 MLP compute (params f32), batch-size sweep for the headline, XLA
+    cost-model MFU + HBM utilisation, an embedding-gather roofline probe
+    (the step is gather-bound by design — SURVEY.md hard part 2), and AUC
+    from a short convergence run so the steps/sec number is of a step that
+    demonstrably learns."""
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+
+    spec, trainer = _trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        model_params="vocab_capacity=1048576;embed_dim=16;bf16=True",
+        use_bf16=True,
+    )
+    peaks = _device_peaks()
+    sweep = {}
+    best = None
+    state = None
+    for batch_size in (4096, 8192, 16384, 32768):
+        batch = _make_criteo_batch(batch_size)
+        state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+        steps_per_sec, _ = trainer.timed_steps_per_sec(
+            state, batch, iters=iters
+        )
+        examples_per_sec = steps_per_sec * batch_size
+        sweep[batch_size] = round(examples_per_sec, 1)
+        if best is None or examples_per_sec > best[1]:
+            best = (batch_size, examples_per_sec, steps_per_sec)
+    batch_size, examples_per_sec, steps_per_sec = best
+
+    # XLA cost model on the winning shape -> MFU + HBM utilisation
+    batch = _make_criteo_batch(batch_size)
     state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
-    steps_per_sec, _ = trainer.timed_steps_per_sec(state, batch, iters=iters)
+    sharded = mesh_lib.shard_batch(batch, trainer.mesh)
+    cost = _cost(trainer.train_step.lower(state, sharded).compile())
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+    detail = {
+        "steps_per_sec": round(steps_per_sec, 2),
+        "batch_size": batch_size,
+        "batch_sweep_examples_per_sec": sweep,
+        "vocab_capacity": 1 << 20,
+        "embed_dim": 16,
+        "compute_dtype": "bfloat16",
+        "param_dtype": "float32",
+        "device": str(jax.devices()[0]),
+        "step_flops_xla": flops,
+        # XLA cost-model operand bytes: an upper bound on logical access,
+        # NOT physical HBM traffic (fusion/VMEM reuse make it exceed the
+        # HBM roof) — recorded for step-to-step comparison only.
+        "step_bytes_accessed_xla_costmodel": bytes_accessed,
+    }
+    if flops:
+        detail["achieved_tflops"] = round(flops * steps_per_sec / 1e12, 2)
+    if peaks and flops:
+        detail["mfu"] = round(flops * steps_per_sec / peaks["bf16_flops"], 4)
+
+    # Embedding-gather roofline probe: the two table lookups, isolated.
+    # bytes moved ~= B*26*(16+1)*4 gathered + id traffic; gather-bound
+    # steps sit near the HBM roof, which is the design-note evidence for
+    # plain-gather vs SparseCore (SURVEY.md §7 hard part 2).
+    table = state.params["params"]["fm_embedding"]["embedding"]
+    linear = state.params["params"]["fm_linear"]["embedding"]
+    ids = jnp.asarray(batch["features"]["sparse"] % (1 << 20))
+
+    @jax.jit
+    def gather_probe(t, lin, ids):
+        return jnp.take(t, ids, axis=0).sum() + jnp.take(
+            lin, ids, axis=0
+        ).sum()
+
+    gather_probe(table, linear, ids).block_until_ready()
+    import time as _time
+
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        out = gather_probe(table, linear, ids)
+    out.block_until_ready()
+    gather_s = (_time.perf_counter() - t0) / iters
+    gather_bytes = batch_size * 26 * (16 + 1) * 4
+    detail["gather_probe_ms"] = round(gather_s * 1e3, 3)
+    detail["gather_gbytes_per_s"] = round(gather_bytes / gather_s / 1e9, 1)
+    detail["gather_fraction_of_step"] = round(
+        gather_s * steps_per_sec, 3
+    )
+
+    detail["auc_synthetic_criteo"] = round(_deepfm_auc(), 4)
+    # Round-2 measured headline (BENCH_r02.json): 8.24M ex/s f32 @4096.
+    # The reference publishes nothing (BASELINE.json published: {}), so
+    # the prior round is the operative baseline.
+    r02 = 8_240_000.0
     return {
         "metric": "deepfm_criteo_train_examples_per_sec",
-        "value": round(steps_per_sec * batch_size, 1),
+        "value": round(examples_per_sec, 1),
         "unit": "examples/sec",
-        "vs_baseline": 1.0,
-        "detail": {
-            "steps_per_sec": round(steps_per_sec, 2),
-            "batch_size": batch_size,
-            "vocab_capacity": 1 << 20,
-            "embed_dim": 16,
-            "device": str(__import__("jax").devices()[0]),
-        },
+        "vs_baseline": round(examples_per_sec / r02, 3),
+        "detail": detail,
     }
 
 

@@ -35,6 +35,11 @@ class DeepFM(nn.Module):
     vocab_capacity: int = 1 << 18  # shared table rows (hash space)
     embed_dim: int = 16
     mlp_dims: tuple = (256, 128)
+    # bf16 puts the MLP matmuls on the MXU at full rate; params stay f32
+    # (flax Dense computes in `dtype`, accumulates/stores kernels in
+    # param_dtype=f32 by default) and the FM reductions stay f32 for
+    # numerical safety.
+    compute_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, features):
@@ -67,16 +72,28 @@ class DeepFM(nn.Module):
         deep_in = jnp.concatenate(
             [dense_n, emb.reshape(emb.shape[0], -1)], axis=-1
         )
-        h = deep_in
+        h = deep_in.astype(self.compute_dtype)
         for i, width in enumerate(self.mlp_dims):
-            h = nn.relu(nn.Dense(width, name=f"mlp_{i}")(h))
-        deep = nn.Dense(1, name="mlp_out")(h)[..., 0]
+            h = nn.relu(
+                nn.Dense(
+                    width, name=f"mlp_{i}", dtype=self.compute_dtype
+                )(h)
+            )
+        deep = nn.Dense(1, name="mlp_out", dtype=self.compute_dtype)(h)[
+            ..., 0
+        ].astype(jnp.float32)
 
         return wide + jnp.sum(first[..., 0], axis=1) + fm2 + deep  # logits
 
 
-def custom_model(vocab_capacity: int = 1 << 18, embed_dim: int = 16):
-    return DeepFM(vocab_capacity=vocab_capacity, embed_dim=embed_dim)
+def custom_model(
+    vocab_capacity: int = 1 << 18, embed_dim: int = 16, bf16: bool = False
+):
+    return DeepFM(
+        vocab_capacity=vocab_capacity,
+        embed_dim=embed_dim,
+        compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+    )
 
 
 def loss(labels, predictions):
