@@ -14,6 +14,9 @@ from typing import Dict, Type
 from elasticdl_tpu.data.reader.base import AbstractDataReader  # noqa: F401
 from elasticdl_tpu.data.reader.csv_reader import CSVDataReader  # noqa: F401
 from elasticdl_tpu.data.reader.memory_reader import MemoryDataReader  # noqa: F401
+from elasticdl_tpu.data.reader.table_reader import (  # noqa: F401
+    TableDataReader,
+)
 from elasticdl_tpu.data.reader.tfrecord_reader import (  # noqa: F401
     TFRecordDataReader,
 )
@@ -43,6 +46,7 @@ def register_data_reader(scheme: str, reader_cls=None):
 
 register_data_reader("csv", CSVDataReader)
 register_data_reader("tfrecord", TFRecordDataReader)
+register_data_reader("sqlite", TableDataReader)
 
 
 def create_data_reader(data_origin: str, **kwargs) -> AbstractDataReader:
