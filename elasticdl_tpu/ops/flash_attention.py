@@ -1,0 +1,216 @@
+"""Pallas TPU flash-attention kernel (single-shard fast path).
+
+The framework's attention stack has two tiers (SURVEY.md §5 long-context —
+net-new capability vs the reference, which has no attention ops at all):
+
+- cross-chip: `ops/ring_attention.py` rotates K/V blocks over ICI with
+  online-softmax accumulation (sequence scales with chips);
+- on-chip (this module): a hand-written Pallas kernel computes the local
+  attention with the same online softmax, tiled for the MXU/VMEM instead
+  of materialising the (L, L) score matrix in HBM.  Used by
+  `ring_self_attention` when the mesh's `seq` axis is 1 (every block is
+  local) and directly by models.
+
+Kernel shape: grid over (batch*heads, Lq/BLOCK_Q); each program holds one
+Q tile resident in VMEM and streams K/V tiles, carrying the running max
+`m`, normaliser `l` and unnormalised accumulator in f32 scratch.  Causal
+masking prunes whole K tiles above the diagonal.  The FORWARD is O(L) in
+HBM (nothing (L, L)-shaped is ever materialised; only the log-sum-exp is
+saved).  Backward is a `jax.custom_vjp` that recomputes probabilities
+from the saved log-sum-exp in plain jnp — XLA fuses it, but its einsum
+operands are O(L^2), so truly long-context TRAINING belongs to the ring
+tier (sequence sharded over chips), where per-chip lengths stay modest.
+
+Off-TPU the kernel runs in Pallas interpret mode (tests exercise the SAME
+kernel code path on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, causal: bool,
+    scale: float, q_len: int, k_len: int, block_q: int,
+):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (BLOCK_Q, D)
+    dim = q.shape[-1]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0
+    )
+
+    num_kb = k_len // block_k
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # (BLOCK_Q, BLOCK_K)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        if causal:
+            # rows fully masked in this tile contribute nothing
+            p = jnp.where(logits > _NEG_INF / 2, p, 0.0)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        o_new = o * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # K tiles strictly above this Q tile's diagonal are all-masked:
+        # stop the stream early instead of computing and zeroing them.
+        last_kb = jnp.minimum(
+            (qi + 1) * block_q + block_k - 1, k_len
+        ) // block_k
+        num_iters = jnp.minimum(num_kb, last_kb)
+    else:
+        num_iters = num_kb
+    o, m, l = jax.lax.fori_loop(0, num_iters, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe).astype(o_ref.dtype)
+    # lse carried as (BLOCK_Q, 1): TPU lowering requires the block's last
+    # dim to be 128-divisible OR equal to the array's — a trailing
+    # singleton satisfies that where a rank-2 (1, BLOCK_Q) block cannot.
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _pallas_forward(q, k, v, causal: bool, scale: float, block_q: int,
+                    block_k: int, interpret: bool):
+    """q/k/v: (BH, L, D) -> (out (BH, L, D), lse (BH, L))."""
+    bh, q_len, dim = q.shape
+    k_len = k.shape[1]
+    grid = (bh, q_len // block_q)
+    kernel = functools.partial(
+        _fwd_kernel,
+        block_k=block_k,
+        causal=causal,
+        scale=scale,
+        q_len=q_len,
+        k_len=k_len,
+        block_q=block_q,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, k_len, dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, k_len, dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q_len, dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    return _flash_fwd(q, k, v, causal, scale)[0]
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    bh, q_len, dim = q.shape
+    block_q = min(128, q_len)
+    block_k = min(128, k.shape[1])
+    out, lse = _pallas_forward(
+        q, k, v, causal, scale, block_q, block_k, _use_interpret()
+    )
+    return out, (q, k, v, out, lse[..., 0])
+
+
+def _flash_bwd(causal, scale, residuals, g):
+    """Flash backward by recompute: probabilities are rebuilt from the
+    saved log-sum-exp, so nothing O(L^2) was ever saved.  Expressed in
+    jnp — XLA fuses the whole thing; the O(L^2) intermediate lives only
+    inside the fused computation."""
+    q, k, v, out, lse = residuals
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    logits = jnp.einsum(
+        "bqd,bkd->bqk", qf, kf, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_len, k_len = q.shape[1], k.shape[1]
+        mask = jnp.arange(q_len)[:, None] >= jnp.arange(k_len)[None, :]
+        logits = jnp.where(mask[None], logits, _NEG_INF)
+    p = jnp.exp(logits - lse[..., None])                 # softmax probs
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+    delta = (gf * out.astype(jnp.float32)).sum(-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v, causal: bool = False, scale: Optional[float] = None
+):
+    """Single-device flash attention; q/k/v: (B, L, H, D) -> (B, L, H, D).
+
+    Differentiable (custom VJP with flash recompute).  Sequence lengths
+    must be multiples of the 128 tile (or shorter than it) — pad upstream
+    if not; head dim <= 128.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch, q_len, heads, dim = q.shape
+    k_len = k.shape[1]
+
+    def bad(length):
+        return (length >= 128 and length % 128 != 0) or (
+            length < 128 and length % 8 != 0
+        )
+
+    # K is validated too: an un-tileable k_len would silently DROP the
+    # tail keys (the kernel streams k_len // block_k whole tiles).
+    if bad(q_len) or bad(k_len) or k.shape != v.shape or dim > 128:
+        raise ValueError(
+            f"flash_attention needs L a multiple of 128 (or a sub-128 "
+            f"multiple of 8) for BOTH q and k/v, k.shape == v.shape, and "
+            f"D <= 128; got Lq={q_len}, Lk={k_len}, D={dim}"
+        )
+
+    def merge(x):
+        return x.transpose(0, 2, 1, 3).reshape(batch * heads, -1, dim)
+
+    out = _flash(merge(q), merge(k), merge(v), causal, scale)
+    return out.reshape(batch, heads, q_len, dim).transpose(0, 2, 1, 3)

@@ -96,6 +96,28 @@ def ring_self_attention(
         scale = q.shape[-1] ** -0.5
     ring_size = mesh.shape[seq_axis]
     spec = P(data_axis, seq_axis, None, None)
+    if ring_size == 1:
+        # Sequence axis unsharded: every K/V block is local, so skip the
+        # ring machinery and run the Pallas flash kernel (same online
+        # softmax, tiled in VMEM — ops/flash_attention.py).  Still under
+        # shard_map over the SAME specs: each data shard runs the kernel
+        # on its local batch, so inputs stay batch-sharded and the output
+        # keeps the documented sharding (a bare call would force full
+        # replication under jit).  Tile-shape constraints (L % 128,
+        # D <= 128) fall back to the fused-lax ring body with ring size 1.
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+
+        try:
+            return jax.shard_map(
+                functools.partial(
+                    flash_attention, causal=causal, scale=scale
+                ),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+            )(q, k, v)
+        except ValueError:
+            pass
     fn = functools.partial(
         _ring_attention_local,
         ring_size=ring_size,
