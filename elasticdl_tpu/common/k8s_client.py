@@ -194,11 +194,18 @@ class ProcessK8sClient(AbstractK8sClient):
             self.phases[name] = PodStatus.DELETED
         if proc is not None and proc.poll() is None:
             proc.terminate()
+        # Emit as soon as deletion is INITIATED (real k8s delivers the
+        # deletionTimestamp event immediately too): the membership bump
+        # then reaches surviving ranks before the condemned process — which
+        # handles SIGTERM by finishing its current task — has left, so
+        # survivors re-mesh gracefully at the next task boundary instead
+        # of wedging in a collective against a vanished peer.
+        self._emit(name, PodStatus.DELETED)
+        if proc is not None and proc.poll() is None:
             try:
                 proc.wait(timeout=15)
             except Exception:
                 proc.kill()
-        self._emit(name, PodStatus.DELETED)
 
     def kill_pod(self, name: str) -> None:
         """Hard preemption (test hook): SIGKILL, then the monitor reports

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Cluster end-to-end suite (SURVEY.md C23 parity with the reference's
+# minikube chaos jobs, without Kubernetes): worker pods run as real OS
+# processes (ProcessK8sClient) through the REAL master and worker entry
+# points — full rendezvous-served jax.distributed bootstrap, then the
+# chaos drills: hard-kill rank 1, hard-kill rank 0 (the coordinator),
+# scale up 2->3 and scale down 2->1 mid-job.  Asserts completion, full
+# record coverage, and measured recovery times.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C native
+python -m pytest tests/test_cluster_e2e.py tests/test_elastic_cluster.py \
+  -q -s "$@"
