@@ -123,6 +123,12 @@ def _train_local(args, job_type: str = "train") -> int:
         checkpoint_steps=args.checkpoint_steps,
     )
 
+    # A restored task journal may already be terminal; the finish check
+    # must run once proactively (it also injects the final-eval round for
+    # the restored model) since no training report will ever drain the
+    # queue.
+    master.task_manager.maybe_finish_if_drained()
+
     workers = []
     threads = []
     for wid in range(args.num_workers):

@@ -76,6 +76,35 @@ class Master:
                 f"job type {self.job_type!r} has no input data "
                 "(--training_data / --validation_data / --prediction_data)"
             )
+        persist_path = None
+        restore_cutoff = None
+        if getattr(args, "checkpoint_dir", "") and self.job_type == "train":
+            import os
+
+            # Master fault tolerance: completed-shard journal lives next
+            # to the model checkpoints; a relaunched master pod resumes
+            # the epoch instead of retraining it.  The journal is only
+            # trusted up to the newest MODEL checkpoint's STEP — a shard
+            # completed at a later model version has gradients the
+            # restored params never saw, so it must re-run; with no model
+            # checkpoint at all the journal is orphaned and discarded
+            # (resuming the task queue without resuming the model would
+            # silently drop that data from training).
+            persist_path = os.path.join(
+                args.checkpoint_dir, "task_state.json"
+            )
+            restore_cutoff = _latest_model_checkpoint_step(
+                args.checkpoint_dir
+            )
+            if restore_cutoff is None and os.path.exists(persist_path):
+                logger.warning(
+                    "Discarding orphaned task journal %s (no model "
+                    "checkpoint to pair it with)", persist_path,
+                )
+                try:
+                    os.remove(persist_path)
+                except OSError:
+                    pass
         self.task_manager = TaskManager(
             training_shards=training_shards,
             evaluation_shards=evaluation_shards,
@@ -84,6 +113,8 @@ class Master:
             lease_timeout_s=args.task_lease_timeout_s,
             shuffle_shards=True,
             shuffle_seed=0,
+            persist_path=persist_path,
+            restore_cutoff_step=restore_cutoff,
         )
         # evaluate-only jobs: the eval round IS the job — inject upfront.
         if self.job_type == "evaluate" and evaluation_shards:
@@ -207,6 +238,10 @@ class Master:
         actual = self.start_grpc(port)
         if self.pod_manager is not None:
             self.pod_manager.start()
+        # A restored task journal may already be terminal (all shards of
+        # the final epoch done): no worker report will ever drain the
+        # queue, so give the finish check one proactive run.
+        self.task_manager.maybe_finish_if_drained()
         return actual
 
     def start_grpc(self, port: Optional[int] = None) -> int:
@@ -327,6 +362,24 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
     time.sleep(linger_s)
     master.stop()
     return 0 if ok else 1
+
+
+def _latest_model_checkpoint_step(checkpoint_dir: str):
+    """STEP of the newest finalized Orbax checkpoint (its digit-named dir),
+    or None when no finalized model checkpoint exists.  Step-based — never
+    a clock comparison: async checkpoint writes and cross-host clock skew
+    make mtimes unusable for durability decisions."""
+    import os
+
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    steps = [
+        int(name)
+        for name in os.listdir(checkpoint_dir)
+        if name.isdigit()
+        and os.path.isdir(os.path.join(checkpoint_dir, name))
+    ]
+    return max(steps) if steps else None
 
 
 def _parse_resources(spec: str):

@@ -64,6 +64,7 @@ class MasterServicer:
             worker_id=req.worker_id,
             records=req.exec_counters.get("records", 0),
             transient=req.transient,
+            model_version=req.exec_counters.get("model_version", -1),
         )
         return pb.Empty()
 

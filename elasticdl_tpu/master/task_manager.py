@@ -84,6 +84,8 @@ class TaskManager:
         max_task_retries: int = 3,
         shuffle_shards: bool = False,
         shuffle_seed: Optional[int] = None,
+        persist_path: Optional[str] = None,
+        restore_cutoff_step: Optional[int] = None,
     ):
         self._lock = threading.Lock()
         self._training_shards = list(training_shards or [])
@@ -119,12 +121,36 @@ class TaskManager:
         # between the last training report and the injection.
         self._pre_finish_providers: List[Callable[[], List[pb.Task]]] = []
         self._finished = False
+        # Master fault tolerance (beyond the reference, whose restarted
+        # master re-trained the whole epoch — SURVEY.md §3.6): completed
+        # training shards of the CURRENT epoch are journaled (with the
+        # model version at completion) to persist_path, and a restarted
+        # master resumes the epoch without them.  `restore_cutoff_step`
+        # keeps the journal consistent with the MODEL: only shards whose
+        # completion version <= the newest model checkpoint's STEP are
+        # trusted — all optimizer updates through that step are in the
+        # restored params by monotonicity, with no clock comparison across
+        # hosts or async-write windows.  A shard done at a later version
+        # (or with no recorded version) re-runs: its gradients are not in
+        # the checkpoint (at-least-once preserved in both directions).
+        # None means trust everything.  The recovery unit stays the task:
+        # in-flight (unreported) shards at crash time simply re-run.
+        # Armed only AFTER construction: the initial epoch creation below
+        # must not overwrite an existing journal before restore reads it.
+        self._persist_path = None
+        self._done_training_shards: Dict[tuple, int] = {}  # key -> version
+        self._restore_cutoff_step = restore_cutoff_step
+        self._training_records_done = 0
 
         if self._training_shards:
             self._create_training_tasks_locked()
         if self._prediction_shards:
             for shard in self._prediction_shards:
                 self._todo.append(self._new_task(shard, pb.PREDICTION))
+        if persist_path is not None:
+            self._persist_path = persist_path
+            self._maybe_restore_locked(persist_path)
+            self._persist_locked()
 
     # ---- task creation -------------------------------------------------
 
@@ -148,10 +174,121 @@ class TaskManager:
         for shard in shards:
             self._todo.append(self._new_task(shard, pb.TRAINING))
         self._epoch += 1
+        self._done_training_shards.clear()
+        self._persist_locked()
         logger.info(
             "Created %d training tasks for epoch %d",
             len(shards), self._epoch,
         )
+
+    # ---- persistence (master fault tolerance) --------------------------
+
+    @staticmethod
+    def _shard_key(shard: pb.Shard) -> list:
+        return [shard.name, shard.start, shard.end]
+
+    def _persist_locked(self) -> None:
+        """Unthrottled: reports arrive per TASK (not per step), the state
+        is a few KB, and a dropped trailing write would lose the newest
+        shard completions on a crash right after them."""
+        if self._persist_path is None:
+            return
+        import json
+        import os
+
+        state = {
+            "epoch": self._epoch,
+            "done_training_shards": sorted(
+                [*key, v] for key, v in self._done_training_shards.items()
+            ),
+            # training records only: eval/predict records re-accumulate
+            # when their rounds re-run after a restart
+            "records_done": self._training_records_done,
+        }
+        tmp = self._persist_path + ".tmp"
+        try:
+            os.makedirs(
+                os.path.dirname(self._persist_path) or ".", exist_ok=True
+            )
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, self._persist_path)  # atomic
+        except OSError as exc:
+            logger.warning("task-state persist failed: %s", exc)
+
+    def _maybe_restore_locked(self, path: str) -> None:
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return
+        # Parse EVERYTHING before mutating any state: a malformed journal
+        # (bad JSON or valid JSON with the wrong shape) must fall back to
+        # a fresh epoch, not crash the master mid-restore — and nothing
+        # may overwrite the journal until parsing has succeeded.
+        try:
+            with open(path) as f:
+                state = json.load(f)
+            saved_epoch = int(state.get("epoch", 1))
+            saved_records = int(state.get("records_done", 0))
+            entries = [
+                ((str(e[0]), int(e[1]), int(e[2])), int(e[3]))
+                for e in state.get("done_training_shards", [])
+            ]
+        except (OSError, ValueError, TypeError, IndexError, KeyError) as exc:
+            logger.warning(
+                "task-state restore failed (%s); starting the epoch fresh",
+                exc,
+            )
+            return
+        if not self._training_shards:
+            return
+        done: Dict[tuple, int] = {}
+        dropped = dropped_records = 0
+        for key, version in entries:
+            if self._restore_cutoff_step is not None and (
+                version < 0 or version > self._restore_cutoff_step
+            ):
+                # completed at a model version past the checkpointed step
+                # (or unknown): its gradients are not in the restored
+                # params — re-run
+                dropped += 1
+                dropped_records += key[2] - key[1]
+                continue
+            done[key] = version
+        if dropped:
+            logger.info(
+                "%d journaled shards post-date the model checkpoint "
+                "(step cutoff %s); they will re-run",
+                dropped, self._restore_cutoff_step,
+            )
+        # Rebuild the CURRENT epoch deterministically (per-epoch shuffle
+        # seed), minus the trusted done shards.
+        self._todo = deque(
+            t for t in self._todo if t.type != pb.TRAINING
+        )
+        self._epoch = max(0, saved_epoch - 1)
+        self._create_training_tasks_locked()  # sets epoch back, persists
+        if done:
+            self._todo = deque(
+                t
+                for t in self._todo
+                if not (
+                    t.type == pb.TRAINING
+                    and tuple(self._shard_key(t.shard)) in done
+                )
+            )
+            self._done_training_shards = dict(done)
+        # re-running shards get re-counted when they re-complete
+        self._training_records_done = max(0, saved_records - dropped_records)
+        self.counters.records_done = self._training_records_done
+        logger.info(
+            "Restored task state: epoch %d, %d/%d shards already done, "
+            "training records_done=%d",
+            self._epoch, len(done), len(self._training_shards),
+            self._training_records_done,
+        )
+        self._persist_locked()
 
     def create_evaluation_tasks(self, model_version: int) -> int:
         """Inject evaluation tasks (called by the evaluation service)."""
@@ -230,10 +367,13 @@ class TaskManager:
     TRANSIENT_HOLD_S = 1.0
 
     def report(self, task_id: int, success: bool, worker_id: int = -1,
-               records: int = 0, transient: bool = False) -> bool:
+               records: int = 0, transient: bool = False,
+               model_version: int = -1) -> bool:
         """Worker reports a leased task done/failed.  Returns False for an
         unknown lease (e.g. already reaped) — the reference likewise ignores
-        stale reports."""
+        stale reports.  `model_version` = the reporter's model step at
+        completion (training tasks); journaled for step-based restore
+        durability."""
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
@@ -246,6 +386,12 @@ class TaskManager:
                 self.counters.by_type[task.type] = (
                     self.counters.by_type.get(task.type, 0) + 1
                 )
+                if task.type == pb.TRAINING:
+                    self._training_records_done += records
+                    self._done_training_shards[
+                        tuple(self._shard_key(task.shard))
+                    ] = model_version
+                    self._persist_locked()
             elif transient and (
                 self._transient_count.get(task_id, 0)
                 < self.MAX_TRANSIENT_REQUEUES
@@ -334,6 +480,18 @@ class TaskManager:
         inject when the queue first drains; called under the task-manager
         lock, so it must not call back into this TaskManager."""
         self._pre_finish_providers.append(provider)
+
+    def maybe_finish_if_drained(self) -> None:
+        """Run the finish check outside any report.  Needed at master
+        start when a restored journal is already terminal (every shard of
+        the final epoch done): no report will ever arrive to drain the
+        queue, so the check must run once proactively — it also gives the
+        pre-finish providers (final eval, SAVE_MODEL) their injection
+        window, exactly as a report-driven drain would."""
+        with self._lock:
+            fire = self._check_all_done_locked()
+        if fire:
+            self._fire_all_done()
 
     def _check_all_done_locked(self) -> bool:
         if self._finished:

@@ -337,7 +337,11 @@ class SPMDWorker:
         if task.type == pb.TRAINING:
             records = self._train_task(task)
             if self.is_leader:
-                self._data_service.report_task(task, records=records)
+                self._data_service.report_task(
+                    task,
+                    records=records,
+                    model_version=int(self.state.step),
+                )
                 try:
                     self._client.report_version(
                         pb.ReportVersionRequest(

@@ -76,7 +76,7 @@ class TaskDataService:
             return task, False
 
     def report_task(self, task: pb.Task, err: str = "", records: int = 0,
-                    transient: bool = False):
+                    transient: bool = False, model_version: int = -1):
         req = pb.ReportTaskResultRequest(
             task_id=task.task_id,
             err_message=err,
@@ -84,6 +84,12 @@ class TaskDataService:
             transient=transient,
         )
         req.exec_counters["records"] = records
+        if model_version >= 0:
+            # Model step at completion: the master's task journal pairs a
+            # done shard with this version, and on restart trusts it only
+            # when a model checkpoint at >= this step exists (step-based
+            # durability — no cross-host clock comparison).
+            req.exec_counters["model_version"] = model_version
         try:
             self._client.report_task_result(req)
         except Exception as exc:

@@ -153,7 +153,13 @@ class Worker:
             try:
                 invoke_callbacks(self.spec.callbacks, "on_task_start", task)
                 records = self._process_task(task)
-                self._data_service.report_task(task, records=records)
+                self._data_service.report_task(
+                    task,
+                    records=records,
+                    model_version=self._owner.step
+                    if task.type == pb.TRAINING
+                    else -1,
+                )
                 invoke_callbacks(
                     self.spec.callbacks, "on_task_end", task, records
                 )

@@ -1,0 +1,225 @@
+"""Master fault tolerance: the completed-shard journal lets a restarted
+master resume the current epoch instead of retraining it (beyond the
+reference, whose restarted job re-ran the epoch — SURVEY.md §3.6)."""
+
+import os
+
+import pytest
+
+from elasticdl_tpu.master.task_manager import (
+    TaskManager,
+    create_shards_from_ranges,
+)
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+
+
+def _tm(tmp_path, records=320, per_task=64, epochs=2):
+    shards = create_shards_from_ranges([("f", 0, records)], per_task)
+    return TaskManager(
+        training_shards=shards,
+        num_epochs=epochs,
+        shuffle_shards=True,
+        shuffle_seed=0,
+        persist_path=str(tmp_path / "task_state.json"),
+    )
+
+
+def test_restart_skips_done_shards(tmp_path):
+    tm = _tm(tmp_path)
+    done = []
+    for _ in range(3):  # finish 3 of 5 epoch-1 tasks
+        task = tm.get(0)
+        done.append((task.shard.name, task.shard.start, task.shard.end))
+        tm.report(task.task_id, success=True, records=64)
+    # "crash": a brand-new manager from the same args + journal
+    tm2 = _tm(tmp_path)
+    assert tm2.counters.records_done == 3 * 64
+    remaining = []
+    while True:
+        task = tm2.get(0)
+        if task is None:
+            break
+        remaining.append((task.shard.name, task.shard.start, task.shard.end))
+        tm2.report(task.task_id, success=True, records=64)
+    # epoch 1's remaining two shards are exactly the ones never reported,
+    # then epoch 2 re-runs everything
+    assert len(remaining) == 2 + 5
+    assert set(remaining[:2]) == {
+        ("f", lo, lo + 64) for lo in range(0, 320, 64)
+    } - set(done)
+    assert tm2.finished
+    assert tm2.counters.records_done == 2 * 320
+
+
+def test_restart_mid_later_epoch(tmp_path):
+    tm = _tm(tmp_path)
+    for _ in range(5):  # all of epoch 1
+        task = tm.get(0)
+        tm.report(task.task_id, success=True, records=64)
+    task = tm.get(0)  # first task of epoch 2
+    tm.report(task.task_id, success=True, records=64)
+
+    tm2 = _tm(tmp_path)
+    count = 0
+    while True:
+        task = tm2.get(0)
+        if task is None:
+            break
+        tm2.report(task.task_id, success=True, records=64)
+        count += 1
+    assert count == 4  # only epoch 2's remaining shards
+    assert tm2.finished
+    assert tm2.counters.records_done == 2 * 320
+
+
+def test_unreported_inflight_shard_reruns(tmp_path):
+    """A shard leased but never reported is NOT journaled — the restarted
+    master re-queues it (at-least-once, the framework's contract)."""
+    tm = _tm(tmp_path)
+    leased = tm.get(0)
+    done = tm.get(0)
+    tm.report(done.task_id, success=True, records=64)
+
+    tm2 = _tm(tmp_path)
+    keys = []
+    while True:
+        task = tm2.get(0)
+        if task is None:
+            break
+        keys.append((task.shard.start))
+        tm2.report(task.task_id, success=True, records=64)
+    # 4 remaining in epoch 1 (incl. the in-flight one) + 5 in epoch 2
+    assert len(keys) == 4 + 5
+    assert leased.shard.start in keys[:4]
+
+
+def test_corrupt_journal_falls_back_to_fresh_epoch(tmp_path):
+    tm = _tm(tmp_path)
+    task = tm.get(0)
+    tm.report(task.task_id, success=True, records=64)
+    (tmp_path / "task_state.json").write_text("{not json")
+    tm2 = _tm(tmp_path)  # must not raise; trains the full epoch again
+    count = 0
+    while True:
+        t = tm2.get(0)
+        if t is None:
+            break
+        tm2.report(t.task_id, success=True, records=64)
+        count += 1
+    assert count == 10 and tm2.finished
+
+
+def test_journal_written_atomically(tmp_path):
+    tm = _tm(tmp_path)
+    task = tm.get(0)
+    tm.report(task.task_id, success=True, records=64)
+    path = tmp_path / "task_state.json"
+    assert path.exists()
+    assert not os.path.exists(str(path) + ".tmp")
+    import json
+
+    state = json.loads(path.read_text())
+    assert state["epoch"] == 1
+    assert len(state["done_training_shards"]) == 1
+
+
+def test_cutoff_drops_shards_newer_than_model_checkpoint(tmp_path):
+    """Shards journaled at a model version PAST the checkpointed step
+    re-run: their gradients are not in the restored params (at-least-once
+    both ways).  Step-based, never clock-based: async checkpoint writes
+    and cross-host clock skew make time comparisons unsound."""
+    shards = create_shards_from_ranges([("f", 0, 320)], 64)
+    path = str(tmp_path / "task_state.json")
+    tm = TaskManager(
+        training_shards=shards, num_epochs=1,
+        shuffle_shards=True, shuffle_seed=0, persist_path=path,
+    )
+    for step in (2, 4):  # two shards done at steps <= checkpoint step 4
+        task = tm.get(0)
+        tm.report(task.task_id, success=True, records=64, model_version=step)
+    task = tm.get(0)  # a third completes at step 6, PAST the checkpoint
+    tm.report(task.task_id, success=True, records=64, model_version=6)
+
+    tm2 = TaskManager(
+        training_shards=shards, num_epochs=1,
+        shuffle_shards=True, shuffle_seed=0, persist_path=path,
+        restore_cutoff_step=4,
+    )
+    assert tm2.counters.records_done == 2 * 64  # post-cutoff re-counted
+    remaining = 0
+    while True:
+        t = tm2.get(0)
+        if t is None:
+            break
+        tm2.report(t.task_id, success=True, records=64)
+        remaining += 1
+    assert remaining == 3  # 2 never-done + 1 post-checkpoint
+    assert tm2.finished and tm2.counters.records_done == 320
+
+
+def test_master_discards_orphaned_journal(tmp_path):
+    """A journal with NO model checkpoint beside it must be ignored: the
+    job retrains the epoch instead of dropping data."""
+    from elasticdl_tpu.common.args import parse_master_args
+    from elasticdl_tpu.data.record_io import write_tfrecords
+    from elasticdl_tpu.master.main import Master
+
+    data = str(tmp_path / "t.tfrecord")
+    write_tfrecords(data, [b"x" * 10 for _ in range(128)])
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "task_state.json").write_text(
+        '{"epoch": 1, "done_training_shards": '
+        '[["%s", 0, 64, 1.0]], "records_done": 64}' % data
+    )
+    args = parse_master_args(
+        ["--training_data", data, "--records_per_task", "64",
+         "--num_epochs", "1", "--checkpoint_dir", str(ckpt)]
+    )
+    master = Master(args)
+    # full epoch queued: nothing was skipped, journal was discarded
+    n = 0
+    while master.task_manager.get(0) is not None:
+        n += 1
+    assert n == 2
+
+
+def test_malformed_entries_fall_back_without_destroying_journal_progress(
+    tmp_path,
+):
+    """Valid JSON with the wrong entry shape must fall back to a fresh
+    epoch cleanly — no crash, no partial restore."""
+    shards = create_shards_from_ranges([("f", 0, 320)], 64)
+    path = tmp_path / "task_state.json"
+    path.write_text(
+        '{"epoch": 1, "done_training_shards": [["f", 0, 64]], '
+        '"records_done": 64}'  # entry missing the version field
+    )
+    tm = TaskManager(
+        training_shards=shards, num_epochs=1,
+        shuffle_shards=True, shuffle_seed=0, persist_path=str(path),
+    )
+    count = 0
+    while tm.get(0) is not None:
+        count += 1
+    assert count == 5  # full fresh epoch
+
+
+def test_unknown_version_with_cutoff_reruns(tmp_path):
+    """A journal entry with no recorded model version cannot be proven
+    durable against a checkpoint step — it re-runs."""
+    shards = create_shards_from_ranges([("f", 0, 128)], 64)
+    path = str(tmp_path / "task_state.json")
+    tm = TaskManager(
+        training_shards=shards, num_epochs=1, persist_path=path,
+    )
+    task = tm.get(0)
+    tm.report(task.task_id, success=True, records=64)  # version unknown
+    tm2 = TaskManager(
+        training_shards=shards, num_epochs=1, persist_path=path,
+        restore_cutoff_step=100,
+    )
+    count = 0
+    while tm2.get(0) is not None:
+        count += 1
+    assert count == 2  # both shards re-queued
