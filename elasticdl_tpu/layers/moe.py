@@ -1,0 +1,148 @@
+"""Mixture-of-Experts layer with expert parallelism over the mesh
+`expert` axis.
+
+Net-new capability relative to the reference (SURVEY.md §2: upstream has
+NO expert parallelism), completing the framework's fourth mesh axis.
+Design follows the GShard/Switch dense-dispatch recipe, expressed the
+pjit way (SURVEY.md §7: annotate shardings, let XLA insert collectives):
+
+- the router computes top-1 gates per token; dispatch/combine are DENSE
+  one-hot tensors (tokens, experts, capacity) built with static shapes —
+  no sorting, no dynamic shapes, nothing the TPU can't tile;
+- expert weights are stacked as (experts, ...) arrays whose leading dim
+  is sharded `P("expert", ...)` (see `moe_param_sharding`); the dispatch
+  einsum then contracts a token-sharded operand against an
+  expert-sharded one, and the XLA SPMD partitioner emits the all-to-all
+  over ICI that hand-written MoE frameworks schedule manually;
+- fixed expert capacity (capacity_factor * tokens / experts) bounds
+  memory; overflowing tokens fall through the residual connection
+  (standard Switch semantics — the layer returns gate-weighted expert
+  output, zeros for dropped tokens, so callers add the residual).
+
+Capacity assignment uses the standard position-in-expert cumsum, which
+is deterministic and position-biased (earlier tokens win slots), exactly
+like the reference implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (Switch) MoE feed-forward block: (..., hidden) -> (..., hidden).
+
+    num_experts:     total experts (shard over the mesh `expert` axis)
+    ffn_dim:         per-expert intermediate width
+    capacity_factor: slots per expert = ceil(tokens/experts * factor)
+    aux_loss_coef:   weight of the sown Switch load-balancing loss; the
+                     Trainer adds every sown `moe_aux_loss` to the
+                     training objective, so routing cannot collapse onto
+                     one expert
+    """
+
+    num_experts: int
+    ffn_dim: int
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        *batch_dims, hidden = x.shape
+        tokens = x.reshape(-1, hidden)                      # (N, H)
+        n_tokens = tokens.shape[0]
+        capacity = max(
+            1,
+            int(-(-n_tokens * self.capacity_factor // self.num_experts)),
+        )
+
+        logits = nn.Dense(self.num_experts, name="router")(
+            tokens.astype(jnp.float32)
+        )                                                   # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)             # (N,)
+        gate = jnp.take_along_axis(
+            probs, expert_idx[:, None], axis=-1
+        )[:, 0]                                             # (N,)
+
+        # position of each token within its expert's queue (static shapes)
+        onehot = jax.nn.one_hot(
+            expert_idx, self.num_experts, dtype=jnp.int32
+        )                                                   # (N, E)
+        position = jnp.cumsum(onehot, axis=0) * onehot - 1  # (N, E)
+        kept = (position >= 0) & (position < capacity)
+        # dispatch: (N, E, C) one-hot; combine adds the gate weight
+        pos_clipped = jnp.clip(position, 0, capacity - 1)
+        dispatch = (
+            jax.nn.one_hot(pos_clipped, capacity, dtype=tokens.dtype)
+            * kept.astype(tokens.dtype)[..., None]
+        )                                                   # (N, E, C)
+        combine = dispatch * gate[:, None, None].astype(tokens.dtype)
+
+        # route tokens to experts: XLA shards `e` (expert axis) and emits
+        # the all-to-all from the shardings
+        expert_in = jnp.einsum(
+            "nec,nh->ech", dispatch, tokens.astype(self.compute_dtype)
+        )                                                   # (E, C, H)
+
+        w_in = self.param(
+            "expert_w_in",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, hidden, self.ffn_dim),
+        )
+        b_in = self.param(
+            "expert_b_in", nn.initializers.zeros,
+            (self.num_experts, self.ffn_dim),
+        )
+        w_out = self.param(
+            "expert_w_out",
+            nn.initializers.lecun_normal(),
+            (self.num_experts, self.ffn_dim, hidden),
+        )
+        b_out = self.param(
+            "expert_b_out", nn.initializers.zeros,
+            (self.num_experts, hidden),
+        )
+        h = jnp.einsum(
+            "ech,ehf->ecf", expert_in, w_in.astype(self.compute_dtype)
+        ) + b_in[:, None, :].astype(self.compute_dtype)
+        h = nn.relu(h)
+        expert_out = jnp.einsum(
+            "ecf,efh->ech", h, w_out.astype(self.compute_dtype)
+        ) + b_out[:, None, :].astype(self.compute_dtype)    # (E, C, H)
+
+        out = jnp.einsum(
+            "nec,ech->nh", combine, expert_out.astype(jnp.float32)
+        )
+        # auxiliary load-balancing loss (Switch eq.4), pre-scaled by its
+        # coefficient; the Trainer sums every sown `moe_aux_loss` into the
+        # training objective (worker/trainer.py)
+        density = onehot.astype(jnp.float32).mean(axis=0)
+        density_proxy = probs.mean(axis=0)
+        self.sow(
+            "intermediates", "moe_aux_loss",
+            self.aux_loss_coef
+            * self.num_experts
+            * jnp.sum(density * density_proxy),
+        )
+        return out.astype(x.dtype).reshape(*batch_dims, hidden)
+
+
+def moe_param_sharding(path, value) -> Optional[P]:
+    """`param_sharding` helper: stack-of-experts params shard their
+    leading (expert) dim over the mesh `expert` axis; compose with other
+    helpers for models that also have sharded embeddings."""
+    names = [getattr(k, "key", str(k)) for k in path]
+    if any(str(n).startswith("expert_") for n in names):
+        ndim = getattr(value, "ndim", 0)
+        if ndim >= 2:
+            return P("expert", *([None] * (ndim - 1)))
+        if ndim == 1:
+            return P("expert")
+    return None

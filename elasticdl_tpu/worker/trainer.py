@@ -34,6 +34,18 @@ from elasticdl_tpu.parallel import mesh as mesh_lib
 logger = get_logger(__name__)
 
 
+def _sown_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum every `moe_aux_loss` value sown anywhere in the module tree
+    (already scaled by its coefficient at sow time).  Zero when nothing
+    was sown — models without auxiliary objectives are unaffected."""
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        names = [getattr(k, "key", str(k)) for k in path]
+        if "moe_aux_loss" in names:
+            total = total + jnp.asarray(leaf, jnp.float32)
+    return total
+
+
 class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
     params: Any          # trainable variables ({"params": ...})
@@ -198,20 +210,21 @@ class Trainer:
         def loss_of(params, model_state, features, labels):
             variables = {**params, **model_state}
             kwargs = {"train": True} if self._has_train_kwarg else {}
-            mutable = list(model_state.keys())
-            if mutable:
-                preds, new_model_state = self.model.apply(
-                    variables, self._cast(features), mutable=mutable,
-                    **kwargs,
-                )
-            else:
-                preds = self.model.apply(
-                    variables, self._cast(features), **kwargs
-                )
-                new_model_state = model_state
+            # "intermediates" is always mutable in the TRAIN step so
+            # layer-sown auxiliary objectives (MoE load balancing) reach
+            # the loss; sown values are ephemeral and never enter the
+            # persistent model_state.
+            mutable = list(model_state.keys()) + ["intermediates"]
+            preds, updates = self.model.apply(
+                variables, self._cast(features), mutable=mutable, **kwargs
+            )
+            updates = dict(updates)
+            intermediates = updates.pop("intermediates", {})
+            new_model_state = updates if updates else model_state
             loss = jnp.asarray(
                 self.loss_fn(labels, preds.astype(jnp.float32)), jnp.float32
             )
+            loss = loss + _sown_aux_loss(intermediates)
             return loss, new_model_state
 
         def train_step(state: TrainState, batch):

@@ -60,14 +60,26 @@ class TransformerBlock(nn.Module):
     hidden: int
     heads: int
     mlp_dim: int
+    # > 0 replaces the dense FFN with a Switch MoE block of this many
+    # experts, sharded over the mesh `expert` axis (expert parallelism —
+    # capability the reference does not have)
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x):
         y = RingSelfAttention(self.hidden, self.heads, name="attention")(x)
         x = nn.LayerNorm()(x + y)
-        y = nn.Dense(self.mlp_dim)(x)
-        y = nn.gelu(y)
-        y = nn.Dense(self.hidden)(y)
+        if self.moe_experts > 0:
+            from elasticdl_tpu.layers.moe import MoEMLP
+
+            y = MoEMLP(
+                num_experts=self.moe_experts, ffn_dim=self.mlp_dim,
+                name="moe_mlp",
+            )(x)
+        else:
+            y = nn.Dense(self.mlp_dim)(x)
+            y = nn.gelu(y)
+            y = nn.Dense(self.hidden)(y)
         return nn.LayerNorm()(x + y)
 
 
@@ -79,6 +91,7 @@ class BertClassifier(nn.Module):
     mlp_dim: int = 3072
     max_len: int = MAX_LEN
     num_classes: int = 2
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, features):
@@ -96,7 +109,8 @@ class BertClassifier(nn.Module):
         x = nn.LayerNorm()(x)
         for i in range(self.num_layers):
             x = TransformerBlock(
-                self.hidden, self.heads, self.mlp_dim, name=f"layer_{i}"
+                self.hidden, self.heads, self.mlp_dim,
+                moe_experts=self.moe_experts, name=f"layer_{i}",
             )(x)
         # max-pool over sequence: sharp feature detection, and ring-
         # friendly (a cross-shard reduce, no CLS gather from one shard)
@@ -107,10 +121,11 @@ class BertClassifier(nn.Module):
 
 def custom_model(hidden: int = 768, num_layers: int = 12, heads: int = 12,
                  mlp_dim: int = 3072, max_len: int = MAX_LEN,
-                 vocab_size: int = VOCAB_SIZE):
+                 vocab_size: int = VOCAB_SIZE, moe_experts: int = 0):
     return BertClassifier(
         vocab_size=vocab_size, hidden=hidden, num_layers=num_layers,
         heads=heads, mlp_dim=mlp_dim, max_len=max_len,
+        moe_experts=moe_experts,
     )
 
 
@@ -148,4 +163,12 @@ def eval_metrics_fn():
     }
 
 
-param_sharding = embedding_param_sharding
+def param_sharding(path, value):
+    """Sharded embedding tables over `model` + expert stacks over
+    `expert` (when moe_experts > 0); everything else replicated."""
+    from elasticdl_tpu.layers.moe import moe_param_sharding
+
+    spec = moe_param_sharding(path, value)
+    if spec is not None:
+        return spec
+    return embedding_param_sharding(path, value)

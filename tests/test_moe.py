@@ -1,0 +1,183 @@
+"""Mixture-of-Experts layer: routing numerics, capacity semantics,
+expert-parallel sharding over the mesh `expert` axis, gradient flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.layers.moe import MoEMLP, moe_param_sharding
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+
+def _layer(num_experts=4, hidden=16, ffn=32, capacity_factor=4.0):
+    layer = MoEMLP(
+        num_experts=num_experts, ffn_dim=ffn,
+        capacity_factor=capacity_factor,
+    )
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(2, 8, hidden).astype(np.float32)
+    )
+    params = layer.init(jax.random.PRNGKey(0), x)
+    return layer, params, x
+
+
+def _dense_reference(layer, params, x):
+    """Apply each token's top-1 expert directly (no dispatch tensors)."""
+    p = params["params"]
+    hidden = x.shape[-1]
+    tokens = np.asarray(x).reshape(-1, hidden)
+    logits = tokens @ np.asarray(p["router"]["kernel"]) + np.asarray(
+        p["router"]["bias"]
+    )
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    idx = probs.argmax(-1)
+    out = np.zeros_like(tokens)
+    for i, e in enumerate(idx):
+        h = np.maximum(
+            tokens[i] @ np.asarray(p["expert_w_in"][e])
+            + np.asarray(p["expert_b_in"][e]),
+            0.0,
+        )
+        out[i] = (
+            h @ np.asarray(p["expert_w_out"][e])
+            + np.asarray(p["expert_b_out"][e])
+        ) * probs[i, e]
+    return out.reshape(x.shape)
+
+
+def test_matches_dense_reference_with_ample_capacity():
+    layer, params, x = _layer()
+    out = layer.apply(params, x)
+    ref = _dense_reference(layer, params, x)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_capacity_overflow_drops_tokens_to_zero():
+    """With capacity 1 per expert, overflowing tokens contribute zeros
+    (Switch semantics: they ride the residual connection)."""
+    layer = MoEMLP(num_experts=2, ffn_dim=8, capacity_factor=0.125)
+    x = jnp.ones((1, 16, 4), jnp.float32)  # identical tokens, same expert
+    params = layer.init(jax.random.PRNGKey(0), x)
+    out = np.asarray(layer.apply(params, x))
+    flat = out.reshape(16, 4)
+    nonzero = (np.abs(flat).sum(-1) > 0).sum()
+    assert nonzero <= 2  # at most one slot per expert
+    assert (np.abs(flat).sum(-1) == 0).sum() >= 14
+
+
+def test_expert_parallel_matches_unsharded():
+    """Params sharded P('expert', ...) over an expert=2 mesh produce the
+    same output as the unsharded layer; the partitioner owns the routing
+    all-to-all."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = mesh_lib.create_mesh(devices, data=4, expert=2)
+    layer, params, _ = _layer()
+    x = jnp.asarray(
+        np.random.RandomState(1).randn(8, 8, 16).astype(np.float32)
+    )
+    unsharded = layer.apply(params, x)
+
+    def spec_for(path, leaf):
+        spec = moe_param_sharding(path, leaf)
+        return NamedSharding(mesh, spec if spec is not None else P())
+
+    sharded_params = jax.tree_util.tree_map_with_path(spec_for, params)
+    params_on_mesh = jax.device_put(
+        params,
+        jax.tree_util.tree_map_with_path(spec_for, params),
+    )
+    x_sharded = jax.device_put(
+        x, NamedSharding(mesh, P("data", None, None))
+    )
+    out = jax.jit(layer.apply)(params_on_mesh, x_sharded)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(unsharded), rtol=1e-4, atol=1e-4
+    )
+    # expert stacks really live sharded over the expert axis
+    w_in = params_on_mesh["params"]["expert_w_in"]
+    assert w_in.sharding.spec == P("expert", None, None)
+
+
+def test_gradients_flow_to_all_param_groups():
+    layer, params, x = _layer()
+
+    def loss(p):
+        return (layer.apply(p, x) ** 2).sum()
+
+    grads = jax.grad(loss)(params)["params"]
+    for name in ("router", "expert_w_in", "expert_w_out"):
+        leaves = jax.tree.leaves(grads[name])
+        assert any(float(jnp.abs(leaf).sum()) > 0 for leaf in leaves), name
+
+
+def test_load_balancing_loss_sown_and_trained():
+    layer, params, x = _layer()
+    _, state = layer.apply(params, x, mutable=["intermediates"])
+    (lb_loss,) = state["intermediates"]["moe_aux_loss"]
+    # coef * E * sum(density*proxy) >= coef (Cauchy-Schwarz; = at uniform)
+    assert float(lb_loss) >= layer.aux_loss_coef * 0.99
+
+    # ...and the Trainer really adds it to the objective: identical
+    # params, aux coefficient on vs off, the reported losses differ by it
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    def make_trainer(coef):
+        model = MoEMLP(
+            num_experts=4, ffn_dim=32, capacity_factor=4.0,
+            aux_loss_coef=coef,
+        )
+        return Trainer(
+            model=model,
+            optimizer=__import__("optax").sgd(0.0),
+            loss_fn=lambda labels, preds: (preds ** 2).mean(),
+        )
+
+    x8 = jnp.asarray(
+        np.random.RandomState(2).randn(8, 8, 16).astype(np.float32)
+    )  # batch divisible by the data axis
+    batch = {"features": x8, "labels": jnp.zeros((x8.shape[0],))}
+    losses = {}
+    for coef in (0.0, 0.5):
+        trainer = make_trainer(coef)
+        state0 = trainer.init_state(jax.random.PRNGKey(0), x8)
+        _, loss = trainer.train_on_batch(state0, batch)
+        losses[coef] = float(loss)
+    assert losses[0.5] > losses[0.0] + 0.4  # aux term >= coef when sown
+
+
+def test_moe_bert_trains_end_to_end():
+    """The zoo BERT with moe_experts>0 trains under jit on a dp x ep mesh
+    and the loss falls — expert parallelism through the full Trainer path."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    spec = get_model_spec(
+        "model_zoo", "bert.bert_finetune.custom_model",
+        model_params=(
+            "hidden=32;num_layers=1;heads=2;mlp_dim=64;max_len=16;"
+            "vocab_size=64;moe_experts=2"
+        ),
+    )
+    mesh = mesh_lib.create_mesh(jax.devices(), data=4, expert=2)
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+        mesh=mesh, param_sharding_fn=spec.param_sharding,
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "input_ids": rng.randint(0, 64, size=(16, 16)).astype(np.int32)
+        },
+        "labels": rng.randint(0, 2, 16).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    first = None
+    for _ in range(12):
+        state, loss = trainer.train_on_batch(state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
