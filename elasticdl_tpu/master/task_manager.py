@@ -141,6 +141,12 @@ class TaskManager:
         self._done_training_shards: Dict[tuple, int] = {}  # key -> version
         self._restore_cutoff_step = restore_cutoff_step
         self._training_records_done = 0
+        # [(completed_epoch, model_version at completion), ...]: an epoch
+        # bump is only trusted on restore when its completion version is
+        # covered by the model checkpoint — otherwise the restored params
+        # predate the bump and the bumped-past epoch's tail would be
+        # silently dropped from training.
+        self._epoch_history: List[Tuple[int, int]] = []
 
         if self._training_shards:
             self._create_training_tasks_locked()
@@ -173,6 +179,13 @@ class TaskManager:
             random.Random(seed).shuffle(shards)
         for shard in shards:
             self._todo.append(self._new_task(shard, pb.TRAINING))
+        if self._done_training_shards:
+            # the epoch just completed: record the model version that
+            # covers ALL of it (-1 when any shard's version is unknown —
+            # untrusted under a checkpoint cutoff)
+            versions = list(self._done_training_shards.values())
+            floor = -1 if min(versions) < 0 else max(versions)
+            self._epoch_history.append((self._epoch, floor))
         self._epoch += 1
         self._done_training_shards.clear()
         self._persist_locked()
@@ -201,6 +214,7 @@ class TaskManager:
             "done_training_shards": sorted(
                 [*key, v] for key, v in self._done_training_shards.items()
             ),
+            "epoch_history": [list(e) for e in self._epoch_history],
             # training records only: eval/predict records re-accumulate
             # when their rounds re-run after a restart
             "records_done": self._training_records_done,
@@ -229,13 +243,22 @@ class TaskManager:
         try:
             with open(path) as f:
                 state = json.load(f)
+            if not isinstance(state, dict):
+                raise ValueError(f"journal top level is {type(state)}")
             saved_epoch = int(state.get("epoch", 1))
             saved_records = int(state.get("records_done", 0))
             entries = [
                 ((str(e[0]), int(e[1]), int(e[2])), int(e[3]))
                 for e in state.get("done_training_shards", [])
             ]
-        except (OSError, ValueError, TypeError, IndexError, KeyError) as exc:
+            history = [
+                (int(e[0]), int(e[1]))
+                for e in state.get("epoch_history", [])
+            ]
+        except (
+            OSError, ValueError, TypeError, IndexError, KeyError,
+            AttributeError,
+        ) as exc:
             logger.warning(
                 "task-state restore failed (%s); starting the epoch fresh",
                 exc,
@@ -243,6 +266,30 @@ class TaskManager:
             return
         if not self._training_shards:
             return
+        if self._restore_cutoff_step is not None:
+            # Only epoch bumps the model checkpoint COVERS are trusted:
+            # resume after the newest completed epoch whose completion
+            # version <= the checkpointed step.  Later bumps happened on
+            # params the checkpoint never saw — those epochs re-run.
+            trusted = [
+                e for e, v in history
+                if 0 <= v <= self._restore_cutoff_step
+            ]
+            durable_epoch = (max(trusted) if trusted else 0) + 1
+            if durable_epoch < saved_epoch:
+                logger.info(
+                    "Journal epoch %d post-dates the model checkpoint "
+                    "(durable through epoch %d); resuming at epoch %d "
+                    "and re-running its shards",
+                    saved_epoch, durable_epoch - 1, durable_epoch,
+                )
+                saved_epoch = durable_epoch
+                entries = []  # they belong to the untrusted later epoch
+            self._epoch_history = [
+                (e, v) for e, v in history if e < saved_epoch
+            ]
+        else:
+            self._epoch_history = list(history)
         done: Dict[tuple, int] = {}
         dropped = dropped_records = 0
         for key, version in entries:

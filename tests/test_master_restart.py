@@ -223,3 +223,67 @@ def test_unknown_version_with_cutoff_reruns(tmp_path):
     while tm2.get(0) is not None:
         count += 1
     assert count == 2  # both shards re-queued
+
+
+def test_untrusted_epoch_bump_regresses(tmp_path):
+    """An epoch bump journaled at a model version past the checkpointed
+    step re-runs that epoch — the bumped-past tail must not be dropped."""
+    import json
+
+    shards = create_shards_from_ranges([("f", 0, 128)], 64)
+    path = tmp_path / "task_state.json"
+    path.write_text(json.dumps({
+        "epoch": 2,                       # journal claims epoch 1 done...
+        "done_training_shards": [],
+        "epoch_history": [[1, 20]],       # ...completed at step 20
+        "records_done": 128,
+    }))
+    tm = TaskManager(
+        training_shards=shards, num_epochs=2,
+        shuffle_shards=True, shuffle_seed=0, persist_path=str(path),
+        restore_cutoff_step=10,           # checkpoint only covers step 10
+    )
+    count = 0
+    while True:
+        t = tm.get(0)
+        if t is None:
+            break
+        tm.report(t.task_id, success=True, records=64, model_version=99)
+        count += 1
+    assert count == 4  # epoch 1 re-ran fully, then epoch 2
+    assert tm.finished
+
+
+def test_trusted_epoch_bump_resumes_later_epoch(tmp_path):
+    import json
+
+    shards = create_shards_from_ranges([("f", 0, 128)], 64)
+    path = tmp_path / "task_state.json"
+    path.write_text(json.dumps({
+        "epoch": 2,
+        "done_training_shards": [],
+        "epoch_history": [[1, 20]],
+        "records_done": 128,
+    }))
+    tm = TaskManager(
+        training_shards=shards, num_epochs=2,
+        shuffle_shards=True, shuffle_seed=0, persist_path=str(path),
+        restore_cutoff_step=25,           # checkpoint covers the bump
+    )
+    count = 0
+    while tm.get(0) is not None:
+        count += 1
+    assert count == 2  # only epoch 2
+
+
+def test_non_dict_journal_falls_back(tmp_path):
+    shards = create_shards_from_ranges([("f", 0, 128)], 64)
+    path = tmp_path / "task_state.json"
+    path.write_text("[1, 2, 3]")  # valid JSON, wrong shape
+    tm = TaskManager(
+        training_shards=shards, num_epochs=1, persist_path=str(path),
+    )
+    count = 0
+    while tm.get(0) is not None:
+        count += 1
+    assert count == 2  # fresh epoch, no crash
