@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
-from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.constants import PodStatus, PodType
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
@@ -63,6 +63,13 @@ class AbstractK8sClient:
     def start_watch(self, callback: EventCallback) -> None:
         raise NotImplementedError
 
+    def list_pods(self) -> List[Tuple[str, int, str, str]]:
+        """Existing pods of this job as (pod_name, worker_id, phase,
+        address).  A replacement master pod calls this to ADOPT live
+        workers instead of double-launching them (master fault
+        tolerance)."""
+        return []
+
     def master_host(self, job_name: str) -> str:
         """Hostname worker pods use to reach the master.  Real clusters
         resolve the master Service's DNS name; process-backed local
@@ -91,9 +98,13 @@ class FakeK8sClient(AbstractK8sClient):
         with self._lock:
             self.phases[spec.name] = PodStatus.RUNNING
         # Fabricated per-pod address, mirroring pod.status.pod_ip.
-        self._emit(
-            spec.name, PodStatus.RUNNING, f"10.0.0.{spec.worker_id + 1}"
-        )
+        self._emit(spec.name, PodStatus.RUNNING, self._pod_address(spec))
+
+    @staticmethod
+    def _pod_address(spec: PodSpec) -> str:
+        """One formula for the fabricated pod IP — create_pod events and
+        list_pods (master adoption) must agree on it."""
+        return f"10.0.0.{spec.worker_id + 1}"
 
     def create_service(
         self, name: str, selector: Dict[str, str], port: int
@@ -113,6 +124,19 @@ class FakeK8sClient(AbstractK8sClient):
     def get_pod_phase(self, name: str) -> str:
         with self._lock:
             return self.phases.get(name, PodStatus.UNKNOWN)
+
+    def list_pods(self):
+        with self._lock:
+            return [
+                (
+                    name,
+                    spec.worker_id,
+                    self.phases.get(name, PodStatus.UNKNOWN),
+                    self._pod_address(spec),
+                )
+                for name, spec in self.pods.items()
+                if spec.pod_type == PodType.WORKER
+            ]
 
     def start_watch(self, callback: EventCallback) -> None:
         self._callback = callback
@@ -218,6 +242,19 @@ class ProcessK8sClient(AbstractK8sClient):
     def get_pod_phase(self, name: str) -> str:
         with self._lock:
             return self.phases.get(name, PodStatus.UNKNOWN)
+
+    def list_pods(self):
+        with self._lock:
+            return [
+                (
+                    name,
+                    spec.worker_id,
+                    self.phases.get(name, PodStatus.UNKNOWN),
+                    "127.0.0.1",
+                )
+                for name, spec in self.pods.items()
+                if spec.pod_type == PodType.WORKER
+            ]
 
     def start_watch(self, callback: EventCallback) -> None:
         self._callback = callback
@@ -341,6 +378,31 @@ class K8sClient(AbstractK8sClient):
     def get_pod_phase(self, name: str) -> str:
         pod = self._core.read_namespaced_pod(name, self._namespace)
         return pod.status.phase
+
+    def list_pods(self):
+        pods = self._core.list_namespaced_pod(
+            self._namespace,
+            label_selector=(
+                f"elasticdl-job={self._job_name},elasticdl-type=worker"
+            ),
+        )
+        out = []
+        for pod in pods.items:
+            try:
+                worker_id = int(
+                    pod.metadata.labels.get("elasticdl-worker-id", -1)
+                )
+            except (TypeError, ValueError):
+                worker_id = -1
+            out.append(
+                (
+                    pod.metadata.name,
+                    worker_id,
+                    pod.status.phase,
+                    pod.status.pod_ip or "",
+                )
+            )
+        return out
 
     def start_watch(self, callback: EventCallback) -> None:
         self._callback = callback

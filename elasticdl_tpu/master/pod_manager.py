@@ -62,8 +62,37 @@ class PodManager:
     # ---- lifecycle -----------------------------------------------------
 
     def start(self):
+        # Master fault tolerance: a REPLACEMENT master adopts the job's
+        # live worker pods (listed by label) instead of double-launching —
+        # the workers keep training through the master outage and
+        # reconnect via their RPC retry loops.
+        adopted = 0
+        with self._lock:
+            for name, worker_id, phase, address in self._k8s.list_pods():
+                if worker_id < 0:
+                    continue
+                # Every listed worker id is burned regardless of phase: a
+                # Failed/Succeeded pod OBJECT still exists under its name
+                # (restartPolicy=Never), and re-launching under the same
+                # id would collide with it (409 AlreadyExists on real
+                # Kubernetes).
+                self._next_worker_id = max(
+                    self._next_worker_id, worker_id + 1
+                )
+                if phase not in (PodStatus.PENDING, PodStatus.RUNNING):
+                    continue
+                self._pod_by_worker[worker_id] = name
+                self._worker_by_pod[name] = worker_id
+                self._phases[name] = phase
+                if self._rendezvous is not None and phase == PodStatus.RUNNING:
+                    self._rendezvous.add_worker(worker_id, address)
+                adopted += 1
+            if self._rendezvous is not None and adopted:
+                self._rendezvous.set_expected(len(self._pod_by_worker))
+        if adopted:
+            logger.info("Adopted %d live worker pods", adopted)
         self._k8s.start_watch(self._event_cb)
-        for _ in range(self._num_workers):
+        for _ in range(max(0, self._num_workers - adopted)):
             self._launch_worker()
 
     def stop(self):

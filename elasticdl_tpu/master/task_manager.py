@@ -100,7 +100,17 @@ class TaskManager:
         self._todo: deque[pb.Task] = deque()
         self._doing: Dict[int, _DoingEntry] = {}
         self._dead_workers: set = set()
-        self._next_task_id = 0
+        # Stale-report guard for master restarts (journaled jobs only): a
+        # worker that leased task N from the PREVIOUS master may report it
+        # to the replacement, whose own task N would be a different shard
+        # — a per-generation random id base makes stale ids miss
+        # (report-for-unknown-task, ignored) instead of silently acking
+        # the wrong shard.
+        self._next_task_id = (
+            random.Random().randrange(1, 1 << 16) << 12
+            if persist_path is not None
+            else 0
+        )
         # Jobs without training data (evaluate/predict-only) have no epochs
         # to run; start with the epoch requirement already satisfied so the
         # job can finish once its eval/predict tasks drain.
