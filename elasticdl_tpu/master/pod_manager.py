@@ -67,8 +67,15 @@ class PodManager:
         # the workers keep training through the master outage and
         # reconnect via their RPC retry loops.
         adopted = 0
+        failed_history = 0
         with self._lock:
-            for name, worker_id, phase, address in self._k8s.list_pods():
+            listed = self._k8s.list_pods()
+            failed_history = sum(
+                1
+                for _, wid, phase, _addr in listed
+                if wid >= 0 and phase == PodStatus.FAILED
+            )
+            for name, worker_id, phase, address in listed:
                 if worker_id < 0:
                     continue
                 # Every listed worker id is burned regardless of phase: a
@@ -86,6 +93,18 @@ class PodManager:
                 self._phases[name] = phase
                 if self._rendezvous is not None and phase == PodStatus.RUNNING:
                     self._rendezvous.add_worker(worker_id, address)
+                # Seed the relaunch chain with the job's visible failure
+                # history: without this, every master restart would reset
+                # every budget and a crash-looping worker co-located with
+                # master churn would be relaunched forever, never reaching
+                # the abort failsafe.  (Approximation: listed Failed pods
+                # can't be attributed to chains, so each adopted chain is
+                # charged the global count — conservative toward abort.)
+                if failed_history:
+                    self._relaunch_count[worker_id] = max(
+                        self._relaunch_count.get(worker_id, 0),
+                        failed_history,
+                    )
                 adopted += 1
             if self._rendezvous is not None and adopted:
                 self._rendezvous.set_expected(len(self._pod_by_worker))

@@ -106,8 +106,11 @@ class TaskManager:
         # — a per-generation random id base makes stale ids miss
         # (report-for-unknown-task, ignored) instead of silently acking
         # the wrong shard.
+        # drawn from the full int32 headroom (floor 2^20 clears any plain
+        # 0-based generation): collision chance for an N-task generation
+        # is ~N / 2^30
         self._next_task_id = (
-            random.Random().randrange(1, 1 << 16) << 12
+            random.Random().randrange(1 << 20, 1 << 30)
             if persist_path is not None
             else 0
         )
