@@ -147,6 +147,10 @@ def _train_local(args, job_type: str = "train") -> int:
             minibatch_size=args.minibatch_size,
             model_owner=owner,
             tensorboard_dir=tb_dir,
+            # one process, one profiler: only worker 0 may trace
+            profile_dir=(
+                getattr(args, "profile_dir", "") if wid == 0 else ""
+            ),
         )
         workers.append(worker)
         thread = threading.Thread(target=worker.run, daemon=True)

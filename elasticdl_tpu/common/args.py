@@ -130,6 +130,11 @@ def add_train_params(parser: argparse.ArgumentParser):
         help="checkpoint to warm-start from",
     )
     parser.add_argument(
+        "--profile_dir", default="",
+        help="capture a JAX profiler trace (Perfetto/XPlane, readable in "
+        "TensorBoard) of the first training task into this directory",
+    )
+    parser.add_argument(
         "--tensorboard_log_dir", default="",
         help="write train-loss/steps-per-sec/eval scalars (workers) and "
         "aggregated eval metrics (master) as TensorBoard event files "

@@ -158,6 +158,11 @@ def main(argv=None):
             output_dir=getattr(args, "output", ""),
             wedge_grace_s=args.wedge_grace_s,
             tensorboard_dir=tb_dir,
+            profile_dir=(
+                os.path.join(args.profile_dir, f"worker-{worker_id}")
+                if args.profile_dir
+                else ""
+            ),
         )
     else:
         worker = Worker(
@@ -170,6 +175,11 @@ def main(argv=None):
             checkpoint_saver=saver_factory() if saver_factory else None,
             checkpoint_steps=args.checkpoint_steps,
             tensorboard_dir=tb_dir,
+            profile_dir=(
+                os.path.join(args.profile_dir, f"worker-{worker_id}")
+                if args.profile_dir
+                else ""
+            ),
         )
     if saver_factory is not None:
         # Preemptible VMs: SIGTERM arrives with a grace window — flush one

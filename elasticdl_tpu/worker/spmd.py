@@ -124,6 +124,7 @@ class SPMDWorker:
         wedge_grace_s: float = 20.0,
         output_dir: str = "",
         tensorboard_dir: str = "",
+        profile_dir: str = "",
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -170,6 +171,11 @@ class SPMDWorker:
         self._summary = SummaryWriter(
             tensorboard_dir if (tensorboard_dir and process_id == 0) else None
         )
+        # one-shot device trace of the first training task (every rank
+        # writes its own subdir — in SPMD each process only sees its
+        # addressable devices)
+        self._profile_dir = profile_dir
+        self._profiled = False
 
     # ---- runtime lifecycle --------------------------------------------
 
@@ -396,6 +402,19 @@ class SPMDWorker:
         return records
 
     def _train_task(self, task: pb.Task) -> int:
+        if self._profile_dir and not self._profiled:
+            self._profiled = True
+            from elasticdl_tpu.common import profiler
+
+            with profiler.trace(self._profile_dir):
+                with profiler.annotate(f"task-{task.task_id}"):
+                    records = self._train_task_inner(task)
+                    if self.last_loss is not None:
+                        jax.block_until_ready(self.last_loss)
+            return records
+        return self._train_task_inner(task)
+
+    def _train_task_inner(self, task: pb.Task) -> int:
         records = 0
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed

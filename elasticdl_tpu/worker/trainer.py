@@ -288,22 +288,13 @@ class Trainer:
         )
         return np.asarray(self.eval_step(state, features))
 
-    def timed_steps_per_sec(self, state, batch, iters: int = 20):
-        batch = mesh_lib.shard_batch(batch, self.mesh)
-        state, loss = self.train_step(state, batch)  # compile
-        jax.block_until_ready(loss)
-        start = time.perf_counter()
-        for _ in range(iters):
-            state, loss = self.train_step(state, batch)
-        jax.block_until_ready(loss)
-        return iters / (time.perf_counter() - start), state
-
     def timed_steps_per_sec_fused(self, state, batch, iters: int = 40):
         """Device-honest step rate: ONE jitted program runs `iters`
         serially-dependent train steps via lax.fori_loop and returns only
         the scalar step counter, synced with a value fetch.
 
-        Why not time per-call dispatch (timed_steps_per_sec)?  Measured
+        Why not time per-call dispatch (a Python loop over train_step
+        with block_until_ready)?  Measured
         pitfalls on remote/tunneled devices: (a) async dispatch makes
         block_until_ready under-report badly — the loop can time Python
         dispatch, not device work (observed >100% "MFU"); (b) returning

@@ -67,6 +67,7 @@ class Worker:
         elastic_manager=None,
         model_owner: Optional[ModelOwner] = None,
         tensorboard_dir: str = "",
+        profile_dir: str = "",
     ):
         self.worker_id = worker_id
         self.spec = spec
@@ -116,6 +117,11 @@ class Worker:
 
         self.step_timer = StepTimer()
         self._summary = SummaryWriter(tensorboard_dir or None)
+        # --profile_dir: capture ONE task's device trace (Perfetto/XPlane,
+        # TensorBoard-readable) then stop — always-on tracing would drag
+        # the hot loop.
+        self._profile_dir = profile_dir
+        self._profiled = False
 
     # ---- owner passthroughs (tests and the client API read these) ------
 
@@ -212,6 +218,21 @@ class Worker:
         export_for_task(self._owner.state, self.spec, task)
 
     def _train_task(self, task: pb.Task) -> int:
+        if self._profile_dir and not self._profiled:
+            self._profiled = True
+            import jax as _jax
+
+            from elasticdl_tpu.common import profiler
+
+            with profiler.trace(self._profile_dir):
+                with profiler.annotate(f"task-{task.task_id}"):
+                    records = self._train_task_inner(task)
+                    if self.losses:
+                        _jax.block_until_ready(self.losses[-1])
+            return records
+        return self._train_task_inner(task)
+
+    def _train_task_inner(self, task: pb.Task) -> int:
         records = 0
         loss = None
         for batch, real in self._data_service.batches_for_task(

@@ -55,7 +55,8 @@ def _run_elastic_job(
     job_name=None,
 ):
     """Launch a 2-process cluster job, hard-kill one rank once a
-    checkpoint exists, return (rc, master, k8s, logs, recovery_times)."""
+    checkpoint exists.  Returns (rc, master, k8s, logs, kill_time);
+    recovery durations live in master.recovery_clock.history."""
     port = _free_port()
     coord_port = _free_port()
     ckpt_dir = str(tmp_path / "ckpt")

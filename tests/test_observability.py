@@ -73,3 +73,30 @@ def test_no_tensorboard_dir_is_noop(mnist_data):
     writer.scalars({"x": 1.0}, step=0)  # must not raise
     writer.flush()
     writer.close()
+
+
+def test_profile_dir_captures_device_trace(mnist_data, tmp_path):
+    """--profile_dir writes a JAX profiler trace (XPlane/Perfetto files
+    TensorBoard can open) of the first training task."""
+    train_dir, _ = mnist_data
+    profile_dir = str(tmp_path / "trace")
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", "model_zoo",
+            "--model_def", "mnist.mnist_functional_api.custom_model",
+            "--training_data", train_dir,
+            "--distribution_strategy", "Local",
+            "--num_epochs", "1",
+            "--minibatch_size", "32",
+            "--records_per_task", "64",
+            "--profile_dir", profile_dir,
+        ]
+    )
+    assert rc == 0
+    traces = glob.glob(
+        os.path.join(profile_dir, "**", "*.xplane.pb"), recursive=True
+    ) + glob.glob(
+        os.path.join(profile_dir, "**", "*.trace.json*"), recursive=True
+    )
+    assert traces, f"no profiler trace under {profile_dir}"
