@@ -24,10 +24,13 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
 
-# (pod_name, phase, pod_address) — address is "" until the cluster layer
-# knows the pod's reachable IP (real k8s can emit RUNNING before the IP is
-# assigned; workers self-report via keep_alive to close that gap).
-EventCallback = Callable[[str, str, str], None]
+# (pod_name, phase, pod_address, exit_code) — address is "" until the
+# cluster layer knows the pod's reachable IP (real k8s can emit RUNNING
+# before the IP is assigned; workers self-report via keep_alive to close
+# that gap); exit_code is the container's exit status when phase is
+# terminal (None when unknown), letting the pod manager tell intentional
+# self-restarts from crashes.
+EventCallback = Callable[[str, str, str, Optional[int]], None]
 
 
 @dataclass
@@ -143,15 +146,17 @@ class FakeK8sClient(AbstractK8sClient):
 
     # ---- test hooks ----------------------------------------------------
 
-    def emit(self, pod_name: str, phase: str, address: str = ""):
+    def emit(self, pod_name: str, phase: str, address: str = "",
+             exit_code=None):
         """Inject a synthetic pod event (e.g. preemption -> FAILED)."""
         with self._lock:
             self.phases[pod_name] = phase
-        self._emit(pod_name, phase, address)
+        self._emit(pod_name, phase, address, exit_code)
 
-    def _emit(self, name: str, phase: str, address: str = ""):
+    def _emit(self, name: str, phase: str, address: str = "",
+              exit_code=None):
         if self._callback is not None:
-            self._callback(name, phase, address)
+            self._callback(name, phase, address, exit_code)
 
 
 class ProcessK8sClient(AbstractK8sClient):
@@ -296,12 +301,13 @@ class ProcessK8sClient(AbstractK8sClient):
                     if self.phases.get(name) != PodStatus.RUNNING:
                         continue
                     self.phases[name] = phase
-                self._emit(name, phase)
+                self._emit(name, phase, exit_code=rc)
             _time.sleep(0.1)
 
-    def _emit(self, name: str, phase: str, address: str = ""):
+    def _emit(self, name: str, phase: str, address: str = "",
+              exit_code=None):
         if self._callback is not None:
-            self._callback(name, phase, address)
+            self._callback(name, phase, address, exit_code)
 
 
 class K8sClient(AbstractK8sClient):
@@ -425,8 +431,16 @@ class K8sClient(AbstractK8sClient):
                     phase = pod.status.phase
                     if event["type"] == "DELETED":
                         phase = PodStatus.DELETED
+                    exit_code = None
+                    try:
+                        for cs in pod.status.container_statuses or []:
+                            if cs.state and cs.state.terminated:
+                                exit_code = cs.state.terminated.exit_code
+                    except AttributeError:
+                        pass
                     self._callback(
-                        pod.metadata.name, phase, pod.status.pod_ip or ""
+                        pod.metadata.name, phase,
+                        pod.status.pod_ip or "", exit_code,
                     )
             except Exception as exc:
                 logger.warning(

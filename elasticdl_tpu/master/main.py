@@ -309,6 +309,10 @@ class Master:
             if self.pod_manager is not None and time.time() > next_stale_check:
                 next_stale_check = time.time() + stale_after
                 stale = self.servicer.stale_workers(stale_after)
+                # only CURRENT workers are interesting: dead workers keep
+                # their last-seen entry forever and would warn every cycle
+                alive = set(self.pod_manager.alive_workers())
+                stale = {w: s for w, s in stale.items() if w in alive}
                 if stale:
                     logger.warning(
                         "Workers silent > %.0fs (lease reaper will recover "

@@ -165,7 +165,15 @@ class PodManager:
 
     # ---- event handling ------------------------------------------------
 
-    def _event_cb(self, pod_name: str, phase: str, address: str = ""):
+    # Exit codes that mean "restart me, I did not crash": the wedge
+    # watchdog (43) and clean topology-change restarts (44) from
+    # worker/spmd.py.  They relaunch WITHOUT charging the chain's
+    # failure budget — a handful of elasticity events must never
+    # exhaust a healthy worker's budget.
+    INTENTIONAL_RESTART_CODES = (43, 44)
+
+    def _event_cb(self, pod_name: str, phase: str, address: str = "",
+                  exit_code=None):
         worker_id = self._worker_by_pod.get(pod_name)
         if worker_id is None:
             return
@@ -182,7 +190,9 @@ class PodManager:
             if self._rendezvous is not None:
                 self._rendezvous.add_worker(worker_id, address)
         elif phase in (PodStatus.FAILED, PodStatus.DELETED):
-            self._on_worker_lost(worker_id, pod_name, phase)
+            self._on_worker_lost(
+                worker_id, pod_name, phase, exit_code=exit_code
+            )
         elif phase == PodStatus.SUCCEEDED:
             with self._lock:
                 self._pod_by_worker.pop(worker_id, None)
@@ -190,7 +200,8 @@ class PodManager:
                 if self._rendezvous is not None:
                     self._rendezvous.set_expected(len(self._pod_by_worker))
 
-    def _on_worker_lost(self, worker_id: int, pod_name: str, phase: str):
+    def _on_worker_lost(self, worker_id: int, pod_name: str, phase: str,
+                        exit_code=None):
         if self._recovery_clock is not None and not self.stopped:
             self._recovery_clock.mark_loss()
         # 1. failure detector -> task lease recovery (at-least-once)
@@ -216,9 +227,10 @@ class PodManager:
         # near-simultaneous failures cannot under-count the chain.
         if self.stopped or phase == PodStatus.DELETED:
             return
+        intentional = exit_code in self.INTENTIONAL_RESTART_CODES
         with self._lock:
             count = self._relaunch_count.get(worker_id, 0)
-            if count >= self._relaunch_budget:
+            if not intentional and count >= self._relaunch_budget:
                 logger.error(
                     "Worker %d exhausted relaunch budget (%d)",
                     worker_id, self._relaunch_budget,
@@ -228,9 +240,13 @@ class PodManager:
             else:
                 # New worker id (reference: replacements get fresh ids);
                 # id allocation + chain count in one critical section.
+                # Intentional self-restarts (watchdog / topology change)
+                # inherit the chain count unchanged.
                 new_id = self._next_worker_id
                 self._next_worker_id += 1
-                self._relaunch_count[new_id] = count + 1
+                self._relaunch_count[new_id] = (
+                    count if intentional else count + 1
+                )
         if new_id is not None:
             self._launch_worker(new_id)
         elif none_alive:

@@ -556,15 +556,67 @@ class SPMDWorker:
 
     # ---- elasticity ----------------------------------------------------
 
+    # Exit code for a clean topology-change restart (distinct from the
+    # watchdog's WEDGED_EXIT_CODE only for log forensics; both relaunch
+    # WITHOUT charging the pod manager's failure budget).
+    TOPOLOGY_RESTART_EXIT_CODE = 44
+
+    def _restart_for_topology_change(self) -> None:
+        """Exit for relaunch at a new topology, best-effort flushing any
+        in-flight async checkpoint first.  The flush is time-bounded in a
+        side thread: with all peers alive (scale events) it completes and
+        preserves up to checkpoint_steps of work; with a dead peer the
+        distributed flush cannot complete and we leave after the bound
+        (recovery then restores the previous committed step)."""
+        saver = self._saver
+        if saver is not None:
+            flusher = threading.Thread(
+                target=lambda: saver.wait_until_finished(), daemon=True
+            )
+            flusher.start()
+            flusher.join(timeout=10.0)
+        logger.info(
+            "Rank %d: topology change; restarting process for a clean "
+            "runtime bootstrap", self.process_id,
+        )
+        os._exit(self.TOPOLOGY_RESTART_EXIT_CODE)
+
     def _re_rendezvous(self, settle_timeout_s: float = 60.0) -> bool:
         """Membership changed: rejoin with the new topology and restore
-        state from the latest checkpoint."""
+        state from the latest checkpoint.
+
+        MULTI-PROCESS topologies restart the process instead of
+        re-initializing in place: an in-process jax.distributed
+        shutdown/re-init leaves per-process library state (observed:
+        Orbax's distributed-barrier counters) out of sync with
+        freshly-booted peers, which can hang the first post-remesh
+        collective checkpoint; and a world-1 survivor cannot call
+        jax.distributed.initialize at all once its backend exists.  A
+        process restart makes every member of the new epoch identically
+        fresh — the same, proven path the wedge watchdog and
+        coordination-service aborts already take; recovery cost is the
+        same restore-from-checkpoint cycle.  Only a topology that stays
+        single-process (no distributed runtime involved on either side)
+        re-meshes in place."""
+        # Restart decision comes BEFORE any barrier participation: a rank
+        # that confirmed the new epoch and THEN exited would release the
+        # barrier for fresh joiners, who would initialize a world whose
+        # members are already gone and wedge until their watchdogs fire.
+        if jax.distributed.is_initialized() or self.num_processes > 1:
+            self._restart_for_topology_change()
         self._recovery_t0 = time.time()
+        # Peek (no confirmation) at the new spec: a single-process worker
+        # growing into a multi-process world must also restart — its XLA
+        # backend already exists, so jax.distributed.initialize would
+        # refuse to run in this process.
+        peek = self._client.get_cluster_spec(
+            pb.GetClusterSpecRequest(worker_id=self.worker_id)
+        )
+        if peek.world_size > 1 or peek.expected_world_size > 1:
+            self._restart_for_topology_change()
         # Wait for a settled, group-confirmed epoch (the same barrier as
-        # first join) so we re-init exactly once, for a topology whose
-        # every member is provably alive.  A timeout means the group never
-        # stabilised around us — exit and let the pod manager relaunch a
-        # fresh process that joins cleanly.
+        # first join).  A timeout means the group never stabilised around
+        # us — exit and let the pod manager relaunch a fresh process.
         self._in_rendezvous_wait = True
         try:
             spec, me = wait_for_confirmed_epoch(
@@ -588,26 +640,6 @@ class SPMDWorker:
             )
             return False
         self._epoch = spec.rendezvous_id
-        if self._saver is not None and self._saver_factory is not None:
-            # The saver holds handles into the OLD backend; flush while the
-            # old runtime is still alive, rebuild after re-init.
-            try:
-                self._saver.wait_until_finished()
-                self._saver.close()
-            except Exception:
-                pass
-            self._saver = None
-        if jax.distributed.is_initialized():
-            jax.distributed.shutdown()
-            # The XLA backend caches the OLD topology; re-initialising at
-            # a new world size requires dropping compiled computations and
-            # the backend itself (verified on the CPU/gloo backend: without
-            # this, initialize() raises "must be called before any JAX
-            # calls").
-            jax.clear_caches()
-            import jax.extend.backend as xb
-
-            xb.clear_backends()
         self.process_id = me.rank
         self.num_processes = spec.world_size
         self._coordinator = spec.coordinator_address or self._coordinator

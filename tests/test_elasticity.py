@@ -254,3 +254,31 @@ def test_scale_down_recovers_tasks_gracefully(mnist_data, spec):
     assert tm.finished
     assert len(pod_manager.alive_workers()) == 2
     pod_manager.stop()
+
+
+def test_intentional_restart_codes_do_not_burn_budget():
+    """Exit codes 43/44 (watchdog / topology-change self-restarts) must
+    relaunch without charging the chain's failure budget — a handful of
+    elasticity events must never exhaust a healthy worker."""
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.master.pod_manager import PodManager
+
+    k8s = FakeK8sClient()
+    manager = PodManager(
+        k8s, job_name="budget", num_workers=1,
+        relaunch_on_worker_failure=1,
+    )
+    manager.start()
+    # five intentional restarts in a row: far past the budget of 1
+    for _ in range(5):
+        (worker_id,) = manager.alive_workers()
+        pod = f"budget-worker-{worker_id}"
+        k8s.emit(pod, "Failed", exit_code=44)
+        assert manager.alive_workers(), "intentional restart not relaunched"
+    # a real crash still charges the budget and (budget=1) the next one
+    # exhausts the chain
+    (worker_id,) = manager.alive_workers()
+    k8s.emit(f"budget-worker-{worker_id}", "Failed", exit_code=1)
+    (worker_id,) = manager.alive_workers()
+    k8s.emit(f"budget-worker-{worker_id}", "Failed", exit_code=1)
+    assert not manager.alive_workers()
