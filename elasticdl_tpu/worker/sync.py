@@ -118,6 +118,11 @@ class ModelOwner:
         ):
             self.checkpoint_saver.save(self.state)
 
+    def snapshot(self):
+        """Donation-safe copy of the current state (see snapshot_state)."""
+        with self.lock:
+            return snapshot_state(self.state)
+
     def state_for_eval(self, requested_version: int):
         """Resolve the state an eval task should score (SURVEY.md §3.5:
         the reference evaluated the model at the task's version, pulled
@@ -143,14 +148,48 @@ class ModelOwner:
                 self.state = self.trainer.replace_state(self.state)
 
 
+def snapshot_state(state):
+    """Donation-safe FORWARD-ONLY copy of a TrainState.
+
+    The train step donates its input state (donate_argnums), so a caller
+    that captures the LIVE state object and keeps using it across batches
+    — an eval task scoring one consistent version while another worker
+    thread keeps training — would read buffers the next train step has
+    already donated (XLA: "Buffer has been deleted or donated", which on
+    the multi-device CPU backend also wedges the whole device queue).
+    Copying under the owner's lock orders the copy before any later
+    donation.
+
+    Only step/params/model_state are copied — everything a forward pass
+    reads.  opt_state (2x param memory under Adam) keeps the live
+    reference: eval/export never touch it, and copying it would roughly
+    triple the snapshot's memory cost.  Do NOT train on a snapshot."""
+    if state is None:
+        return None
+    import jax.numpy as jnp
+
+    def copy_tree(tree):
+        return jax.tree.map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, tree
+        )
+
+    return state.replace(
+        step=copy_tree(state.step),
+        params=copy_tree(state.params),
+        model_state=copy_tree(state.model_state),
+    )
+
+
 def state_at_version(state, checkpoint_saver, requested_version: int):
     """Shared eval-at-version resolution (thread/SPMD workers).
 
     (state, actual_version) where actual_version is what the metrics must
-    be labeled with."""
+    be labeled with.  The returned state is always safe to hold across
+    batches: either a fresh restore or a donation-safe snapshot of the
+    live state (see snapshot_state)."""
     current = -1 if state is None else int(state.step)
     if requested_version < 0 or requested_version == current:
-        return state, current
+        return snapshot_state(state), current
     if checkpoint_saver is not None and state is not None:
         restored = checkpoint_saver.restore_step(requested_version, state)
         if restored is not None:
@@ -160,4 +199,4 @@ def state_at_version(state, checkpoint_saver, requested_version: int):
         "checkpoint); evaluating current state",
         requested_version, current,
     )
-    return state, current
+    return snapshot_state(state), current

@@ -17,6 +17,7 @@ split along `data`.  bfloat16 compute keeps the MXU fed; params stay f32.
 
 from __future__ import annotations
 
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional
@@ -32,6 +33,26 @@ from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 logger = get_logger(__name__)
+
+# Process-wide device-execution serialization for the CPU backend.  The
+# virtual multi-device CPU platform (xla_force_host_platform_device_count)
+# can deadlock when two THREADS dispatch multi-device programs
+# concurrently: each program's collectives rendezvous over the same
+# device threads, and once interleaved neither completes — observed as a
+# permanently wedged `jax.Array._value` that then blocks every later
+# fetch in the process.  Serializing dispatch+completion removes the
+# interleaving.  On TPU the hardware queue order is the serialization and
+# this lock is never taken.
+_CPU_EXEC_LOCK = threading.Lock()
+
+
+def run_device_serialized(fn, *args):
+    """Call fn(*args); on the CPU backend, hold the process-wide execution
+    lock and block until the result is ready (see _CPU_EXEC_LOCK)."""
+    if jax.default_backend() != "cpu":
+        return fn(*args)
+    with _CPU_EXEC_LOCK:
+        return jax.block_until_ready(fn(*args))
 
 
 def _sown_aux_loss(intermediates) -> jnp.ndarray:
@@ -109,6 +130,11 @@ class Trainer:
     # ---- state ---------------------------------------------------------
 
     def init_state(self, rng, sample_features) -> TrainState:
+        return run_device_serialized(
+            self._init_state_impl, rng, sample_features
+        )
+
+    def _init_state_impl(self, rng, sample_features) -> TrainState:
         mesh_lib.set_current_mesh(self.mesh)
         kwargs = {"train": False} if self._has_train_kwarg else {}
         variables = dict(
@@ -151,7 +177,7 @@ class Trainer:
 
         shapes = jax.eval_shape(make)
         shardings = self.state_sharding(shapes)
-        return jax.jit(make, out_shardings=shardings)()
+        return run_device_serialized(jax.jit(make, out_shardings=shardings))
 
     def state_sharding(self, state):
         """Sharding tree for the train state: replicated by default;
@@ -266,27 +292,29 @@ class Trainer:
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
         mesh_lib.set_current_mesh(self.mesh)  # for mesh-aware model code
         batch = mesh_lib.shard_batch(batch, self.mesh)
-        state, loss = self.train_step(state, batch)
+        state, loss = run_device_serialized(self.train_step, state, batch)
         return state, loss
 
     def train_on_global_batch(self, state, global_batch):
         """Train step on a batch already assembled into global arrays
         (mesh.make_global_batch) — the multi-process SPMD hot path."""
         mesh_lib.set_current_mesh(self.mesh)
-        return self.train_step(state, global_batch)
+        return run_device_serialized(self.train_step, state, global_batch)
 
     def predict_on_global_batch(self, state, global_features):
         """Forward pass on global arrays; returns the still-global (data-
         sharded) predictions — callers allgather if they need host values."""
         mesh_lib.set_current_mesh(self.mesh)
-        return self.eval_step(state, global_features)
+        return run_device_serialized(self.eval_step, state, global_features)
 
     def predict_on_batch(self, state, features):
         mesh_lib.set_current_mesh(self.mesh)
         features = jax.tree.map(
             lambda x: jax.device_put(x, self._data), features
         )
-        return np.asarray(self.eval_step(state, features))
+        return np.asarray(
+            run_device_serialized(self.eval_step, state, features)
+        )
 
     def timed_steps_per_sec_fused(self, state, batch, iters: int = 40):
         """Device-honest step rate: ONE jitted program runs `iters`

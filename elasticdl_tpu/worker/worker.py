@@ -215,7 +215,9 @@ class Worker:
         """Checkpoint, and export if the task's config rider asks for it
         (cluster mode: the master injects the output dir at job end)."""
         self._owner.save(force=True)
-        export_for_task(self._owner.state, self.spec, task)
+        # snapshot: another worker thread may still be training (and
+        # donating the live state's buffers) while the export reads it
+        export_for_task(self._owner.snapshot(), self.spec, task)
 
     def _train_task(self, task: pb.Task) -> int:
         if self._profile_dir and not self._profiled:

@@ -68,9 +68,14 @@ def test_state_for_eval_restores_requested_version(mnist_spec, tmp_path):
     # owner's own training state untouched by the eval-time restore
     assert owner.step == 6
 
-    # unavailable version: fall back to current state, honestly labeled
+    # unavailable version: fall back to the current state, honestly
+    # labeled — returned as a donation-safe SNAPSHOT (never the live
+    # object: the next train step donates the live buffers)
     state_x, version_x = owner.state_for_eval(3)
-    assert version_x == 6 and state_x is owner.state
+    assert version_x == 6 and int(state_x.step) == 6
+    assert state_x is not owner.state
+    px = jax.tree.leaves(jax.tree.map(np.asarray, state_x.params))
+    assert all(np.array_equal(a, b) for a, b in zip(px, p6))
     saver.close()
 
 
@@ -164,3 +169,31 @@ def test_typed_get_does_not_leak_training_task_on_epoch_refill():
     # but an unfiltered get picks up epoch 2
     task = tm.get(worker_id=0)
     assert task is not None and task.type == pb.TRAINING
+
+
+def test_eval_snapshot_survives_donating_train(mnist_spec):
+    """Regression: state_for_eval must return a donation-safe snapshot.
+
+    The train step donates its input state; an eval task holds the
+    resolved state across the whole shard while other worker threads keep
+    training.  Holding the LIVE object meant the next train step donated
+    the captured buffers out from under the eval (XLA: "Buffer has been
+    deleted or donated" — and on the multi-device CPU backend the aborted
+    replicated execution wedged the process's device queues for good).
+    No threads needed to reproduce: capture, train once, then read."""
+    owner = ModelOwner(
+        Trainer(
+            model=mnist_spec.model,
+            optimizer=mnist_spec.optimizer,
+            loss_fn=mnist_spec.loss,
+        )
+    )
+    batch = _batch()
+    owner.train_batch(batch)
+    captured, version = owner.state_for_eval(-1)
+    assert version == 1
+    owner.train_batch(batch)  # donates the live state's buffers
+    preds = owner.trainer.predict_on_batch(captured, batch["features"])
+    assert np.isfinite(np.asarray(preds)).all()
+    # the snapshot is the version it claimed: its step is unchanged
+    assert int(captured.step) == 1

@@ -70,24 +70,32 @@ def test_full_job_over_grpc_with_two_workers(mnist_data, spec):
         worker.run()
 
     threads = [
-        threading.Thread(target=run_worker, args=(i,)) for i in range(2)
+        threading.Thread(target=run_worker, args=(i,), daemon=True)
+        for i in range(2)
     ]
-    for t in threads:
-        t.start()
-    assert master.wait(timeout=180)
-    for t in threads:
-        t.join(timeout=30)
-    assert master.task_manager.finished
-    assert master.task_manager.counters.records_done >= 256
-    # End-state parity: the final model saw ALL the data — its step count
-    # equals the total number of training batches across BOTH workers
-    # (diverging replicas would each hold only their own share of steps).
-    assert int(owner.state.step) == 256 // 32
-    assert all(w.model_owner is owner for w in workers)
-    # final evaluation ran and aggregated
-    metrics = master.evaluation_service.latest_metrics()
-    assert metrics is not None and "accuracy" in metrics
-    master.stop()
+    try:
+        for t in threads:
+            t.start()
+        assert master.wait(timeout=180)
+        for t in threads:
+            t.join(timeout=30)
+        assert master.task_manager.finished
+        assert master.task_manager.counters.records_done >= 256
+        # End-state parity: the final model saw ALL the data — its step
+        # count equals the total number of training batches across BOTH
+        # workers (diverging replicas would each hold only their own
+        # share of steps).
+        assert int(owner.state.step) == 256 // 32
+        assert all(w.model_owner is owner for w in workers)
+        # final evaluation ran and aggregated
+        metrics = master.evaluation_service.latest_metrics()
+        assert metrics is not None and "accuracy" in metrics
+    finally:
+        # on failure, leaked threads would keep dispatching device work
+        # under later tests — stop the master so workers drain and exit
+        master.stop()
+        for t in threads:
+            t.join(timeout=30)
 
 
 def test_wire_protocol_sentinels(mnist_data, spec):
@@ -96,15 +104,17 @@ def test_wire_protocol_sentinels(mnist_data, spec):
         ["--training_data", train_dir, "--records_per_task", "256"]
     )
     master = Master(args)
-    port = master.start_grpc(port=0)
-    stub = MasterStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
-    # filter by eval type on a queue with only training tasks -> WAIT
-    resp = stub.get_task(
-        pb.GetTaskRequest(worker_id=0, task_type=pb.EVALUATION,
-                          filter_by_type=True)
-    )
-    assert resp.task.task_id == -1 and not resp.job_finished
-    # unfiltered -> real task
-    resp = stub.get_task(pb.GetTaskRequest(worker_id=0))
-    assert resp.task.task_id >= 0
-    master.stop()
+    try:
+        port = master.start_grpc(port=0)
+        stub = MasterStub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+        # filter by eval type on a queue with only training tasks -> WAIT
+        resp = stub.get_task(
+            pb.GetTaskRequest(worker_id=0, task_type=pb.EVALUATION,
+                              filter_by_type=True)
+        )
+        assert resp.task.task_id == -1 and not resp.job_finished
+        # unfiltered -> real task
+        resp = stub.get_task(pb.GetTaskRequest(worker_id=0))
+        assert resp.task.task_id >= 0
+    finally:
+        master.stop()

@@ -135,17 +135,32 @@ def test_job_via_master_entry_point_survives_preemption(
     main_thread = threading.Thread(target=run_main, daemon=True)
     main_thread.start()
 
-    # let the job make progress, then preempt worker 0 (spot kill)
-    deadline = time.time() + 90
-    while owner.step < 2 and time.time() < deadline:
-        time.sleep(0.05)
-    assert owner.step >= 2, "no training progress before preemption"
-    alive[0].clear()
-    threads[0].join(timeout=60)
-    k8s.emit(pod_names[0], PodStatus.FAILED)
+    try:
+        # let the job make progress, then preempt worker 0 (spot kill)
+        deadline = time.time() + 90
+        while owner.step < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert owner.step >= 2, "no training progress before preemption"
+        alive[0].clear()
+        threads[0].join(timeout=60)
+        k8s.emit(pod_names[0], PodStatus.FAILED)
 
-    main_thread.join(timeout=300)
-    assert result.get("rc") == 0, "master entry point did not complete"
+        main_thread.join(timeout=300)
+        assert result.get("rc") == 0, "master entry point did not complete"
+    finally:
+        if main_thread.is_alive():
+            # failure path: stop every worker thread (they would otherwise
+            # keep dispatching device work under LATER tests), stop pod
+            # replacements, and fail the remaining pods so main() aborts
+            k8s.create_pod = orig_create
+            for flag in alive.values():
+                flag.clear()
+            for name in pod_names.values():
+                try:
+                    k8s.emit(name, PodStatus.FAILED)
+                except Exception:
+                    pass
+            main_thread.join(timeout=60)
 
     # replacement pod launched with a fresh id and a real worker command
     worker_specs = [s for s in k8s.create_calls if s.pod_type == "worker"]
