@@ -32,6 +32,26 @@ def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     flags = re.sub(rf"{_COUNT_FLAG}=\d+\s*", "", env.get("XLA_FLAGS", ""))
     env["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    # Persistent XLA-executable cache shared by every process in the
+    # harness (the in-process suite AND the OS-process cluster drills):
+    # workers re-spawned by elasticity tests compile the same tiny
+    # programs over and over — a disk cache turns all but the first
+    # compile into a read.  Keyed by HLO + compile options, so identical
+    # programs from different ranks share safely.  Per-user path: a
+    # world-shared /tmp dir would hit permission failures (and symlink
+    # hazards) the moment a second user runs the suite on the same host.
+    import getpass
+    import tempfile
+
+    try:
+        user = getpass.getuser()
+    except Exception:
+        user = str(os.getuid()) if hasattr(os, "getuid") else "anon"
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), f"elasticdl_tpu_xla_cache_{user}"
+    )
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     return env
 
 
@@ -40,3 +60,27 @@ def apply_cpu_mesh_env(n_devices: int) -> None:
     import os
 
     os.environ.update(cpu_mesh_env(n_devices))
+
+
+def apply_compilation_cache_config() -> None:
+    """Late-apply the persistent-cache env vars to an already-imported jax.
+
+    jax reads JAX_COMPILATION_CACHE_DIR once, at import; on hosts whose
+    sitecustomize imports jax at interpreter start (this machine's does,
+    to register the TPU plugin), env vars set afterwards by a conftest or
+    a parent process are silently ignored.  Call this after jax import in
+    any entry point that wants the shared executable cache."""
+    import os
+
+    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache:
+        return
+    import jax
+
+    if jax.config.jax_compilation_cache_dir != cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+    min_secs = os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS")
+    if min_secs is not None:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_secs)
+        )

@@ -333,6 +333,11 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
     the full elastic stack (rendezvous + pod manager over a real — or with
     --use_fake_k8s an in-memory — Kubernetes client); tests may inject
     `k8s_client` directly."""
+    from elasticdl_tpu.common.virtual_mesh import (
+        apply_compilation_cache_config,
+    )
+
+    apply_compilation_cache_config()
     args = args_lib.parse_master_args(argv)
     if k8s_client is None and args.distribution_strategy != "Local":
         if args.use_process_k8s:

@@ -85,6 +85,13 @@ def wait_for_membership(client, worker_id: int, poll_s: float = 0.5):
 
 
 def main(argv=None):
+    # honor a parent-provided persistent compile cache even though
+    # sitecustomize imported jax before our env was visible to it
+    from elasticdl_tpu.common.virtual_mesh import (
+        apply_compilation_cache_config,
+    )
+
+    apply_compilation_cache_config()
     args = args_lib.parse_worker_args(argv)
     worker_id = int(
         os.environ.get(WorkerEnv.WORKER_ID, args.worker_id)

@@ -22,3 +22,14 @@ apply_cpu_mesh_env(8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Shared persistent XLA-executable cache: cpu_mesh_env sets the env vars,
+# but sitecustomize already imported jax, so late-apply them to the config
+# (subprocess workers spawned by cluster drills do the same in their
+# mains) — re-spawned processes then read compiled executables from disk
+# instead of recompiling identical programs.
+from elasticdl_tpu.common.virtual_mesh import (  # noqa: E402
+    apply_compilation_cache_config,
+)
+
+apply_compilation_cache_config()
