@@ -1,0 +1,92 @@
+"""Flax wrapper for pipeline parallelism: a stacked block repeated
+`num_layers` times and applied as a GPipe microbatch pipeline over the
+mesh `pipe` axis (ops/pipeline.py).
+
+The whole stack is ONE param subtree with a leading layer axis
+(`stack/<block params>`, leaves shaped (num_layers, ...)), so:
+
+- `pipeline_param_sharding` shards every leaf P('pipe') on that axis —
+  stage s holds its contiguous slice of layers, the optimizer state
+  mirrors it (Trainer.state_sharding matches param structure);
+- the param tree is IDENTICAL whatever the mesh: on a pipe=1 mesh the
+  apply degenerates to a sequential scan, so checkpoints move freely
+  between pipelined and non-pipelined meshes (cross-mesh restore,
+  tests/test_remesh.py) — elasticity does not care about the schedule.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.ops.pipeline import gpipe_spmd
+from elasticdl_tpu.parallel.mesh import PIPE_AXIS, get_current_mesh
+
+
+class GPipeBlocks(nn.Module):
+    """num_layers x block_cls(**block_kwargs), pipelined over `pipe`.
+
+    The block must be shape-preserving ((B', ...) -> (B', ...)) and must
+    not open its own shard_map (it executes inside the pipeline's) — use
+    mesh-free blocks (plain attention/MLP), not ring-attention blocks.
+    """
+
+    block_cls: Type[nn.Module]
+    block_kwargs: Mapping[str, Any]
+    num_layers: int
+    num_microbatches: int = 8
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        block = self.block_cls(**dict(self.block_kwargs))
+        mesh = get_current_mesh()
+        stages = mesh.shape.get(PIPE_AXIS, 1)
+        # microbatches divide the PER-DATA-SHARD batch inside shard_map
+        local = max(x.shape[0] // max(mesh.shape.get("data", 1), 1), 1)
+        mcount = min(self.num_microbatches, local) if stages > 1 else 1
+        while local % mcount:
+            mcount -= 1
+        if stages > 1 and mcount != self.num_microbatches:
+            # clamped to a divisor of the local batch; at mcount=1 the
+            # schedule degenerates to one stage active at a time
+            # (bubble = (P-1)/P) — surface it rather than hide it
+            logging.getLogger(__name__).warning(
+                "GPipeBlocks: num_microbatches=%d does not divide the "
+                "per-data-shard batch %d; running with %d microbatches "
+                "(pipeline bubble %.0f%%)",
+                self.num_microbatches, local, mcount,
+                100.0 * (stages - 1) / (mcount + stages - 1),
+            )
+        mb_shape = (local // mcount,) + x.shape[1:]
+
+        def init_stack(rng):
+            def one(r):
+                return block.init(r, jnp.zeros(mb_shape, x.dtype))["params"]
+
+            return jax.vmap(one)(jax.random.split(rng, self.num_layers))
+
+        stack = self.param("stack", init_stack)
+
+        def apply_one(p, h):
+            return block.apply({"params": p}, h)
+
+        return gpipe_spmd(
+            apply_one, stack, x, mesh,
+            num_microbatches=mcount, remat=self.remat,
+        )
+
+
+def pipeline_param_sharding(path, value):
+    """PartitionSpec for GPipeBlocks params: any leaf under a `stack`
+    param subtree is layer-sharded over `pipe` on its leading axis.
+    Compose into a zoo `param_sharding` before other rules."""
+    names = [getattr(k, "key", str(k)) for k in path]
+    if "stack" in names:
+        return P(PIPE_AXIS)
+    return None

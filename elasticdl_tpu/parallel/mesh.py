@@ -2,12 +2,13 @@
 process-level cluster topology (PS shards + Horovod ring — SURVEY.md C15/C16).
 
 All parallelism in elasticdl-tpu is expressed as a `jax.sharding.Mesh` with
-up to four logical axes:
+up to five logical axes:
 
   data     — data parallelism (the reference's only strategy)
   model    — sharded embedding tables / tensor parallelism
   seq      — sequence/context parallelism (ring attention)
   expert   — expert parallelism (MoE)
+  pipe     — pipeline parallelism (GPipe microbatch schedule, ops/pipeline)
 
 Elasticity = rebuilding the mesh when membership changes: the rendezvous
 server bumps an epoch, every process re-initialises jax.distributed with the
@@ -28,6 +29,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
 
 # Trace-time mesh context: model code (e.g. ring attention inside a Flax
 # module) needs the mesh for shard_map, but zoo `custom_model()` factories
@@ -52,24 +54,30 @@ def create_mesh(
     model: int = 1,
     seq: int = 1,
     expert: int = 1,
+    pipe: int = 1,
 ) -> Mesh:
     """Build a mesh over `devices` (default: all).  `data=-1` absorbs the
     remaining devices after the explicit axes are carved out."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    fixed = model * seq * expert
+    fixed = model * seq * expert * pipe
     if data == -1:
         if n % fixed:
             raise ValueError(
-                f"{n} devices not divisible by model*seq*expert={fixed}"
+                f"{n} devices not divisible by model*seq*expert*pipe={fixed}"
             )
         data = n // fixed
     if data * fixed != n:
         raise ValueError(
-            f"mesh {data}x{model}x{seq}x{expert} != {n} devices"
+            f"mesh {data}x{model}x{seq}x{expert}x{pipe} != {n} devices"
         )
-    arr = np.array(devices).reshape(data, model, seq, expert)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS))
+    # pipe is the OUTERMOST axis: neighbor stages land on ICI-adjacent
+    # device groups, and the data/model/seq axes stay contiguous within a
+    # stage (the same layout logic that keeps gradient reductions on ICI)
+    arr = np.array(devices).reshape(pipe, data, model, seq, expert)
+    return Mesh(
+        arr, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+    )
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

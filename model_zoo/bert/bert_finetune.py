@@ -56,6 +56,54 @@ class RingSelfAttention(nn.Module):
         )
 
 
+class LocalSelfAttention(nn.Module):
+    """Mesh-free attention for pipelined blocks: runs INSIDE the pipeline's
+    shard_map, so it must not open its own (ring attention does).  Uses the
+    on-chip Pallas flash kernel when the shape tiles, else the fused-lax
+    reference path."""
+
+    hidden: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x):
+        from elasticdl_tpu.ops.flash_attention import flash_attention
+        from elasticdl_tpu.ops.ring_attention import full_attention_reference
+
+        batch, length, _ = x.shape
+        head_dim = self.hidden // self.heads
+        qkv = nn.Dense(3 * self.hidden, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (batch, length, self.heads, head_dim)
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        try:
+            out = flash_attention(q, k, v, causal=False)
+        except ValueError:  # un-tileable shape (trace-time check)
+            out = full_attention_reference(q, k, v, causal=False)
+        return nn.Dense(self.hidden, name="out")(
+            out.reshape(batch, length, self.hidden)
+        )
+
+
+class PipelinedBlock(nn.Module):
+    """Shape-preserving transformer block for the GPipe stack (attention
+    tier is local-only; sequence and expert axes belong to the non-
+    pipelined path)."""
+
+    hidden: int
+    heads: int
+    mlp_dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        y = LocalSelfAttention(self.hidden, self.heads, name="attention")(x)
+        x = nn.LayerNorm()(x + y)
+        y = nn.Dense(self.mlp_dim)(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden)(y)
+        return nn.LayerNorm()(x + y)
+
+
 class TransformerBlock(nn.Module):
     hidden: int
     heads: int
@@ -92,6 +140,11 @@ class BertClassifier(nn.Module):
     max_len: int = MAX_LEN
     num_classes: int = 2
     moe_experts: int = 0
+    # > 0 stacks the encoder blocks into a GPipe pipeline over the mesh
+    # `pipe` axis with this many microbatches (pipeline parallelism —
+    # capability the reference does not have).  Mutually exclusive with
+    # moe_experts (the pipelined block is local-attention + dense FFN).
+    pipeline_microbatches: int = 0
 
     @nn.compact
     def __call__(self, features):
@@ -107,11 +160,30 @@ class BertClassifier(nn.Module):
         )
         x = tok + pos[None, : ids.shape[1]]
         x = nn.LayerNorm()(x)
-        for i in range(self.num_layers):
-            x = TransformerBlock(
-                self.hidden, self.heads, self.mlp_dim,
-                moe_experts=self.moe_experts, name=f"layer_{i}",
+        if self.pipeline_microbatches > 0:
+            if self.moe_experts > 0:
+                raise ValueError(
+                    "pipeline_microbatches and moe_experts are mutually "
+                    "exclusive"
+                )
+            from elasticdl_tpu.layers.pipeline import GPipeBlocks
+
+            x = GPipeBlocks(
+                block_cls=PipelinedBlock,
+                block_kwargs={
+                    "hidden": self.hidden, "heads": self.heads,
+                    "mlp_dim": self.mlp_dim,
+                },
+                num_layers=self.num_layers,
+                num_microbatches=self.pipeline_microbatches,
+                name="encoder_pipeline",
             )(x)
+        else:
+            for i in range(self.num_layers):
+                x = TransformerBlock(
+                    self.hidden, self.heads, self.mlp_dim,
+                    moe_experts=self.moe_experts, name=f"layer_{i}",
+                )(x)
         # max-pool over sequence: sharp feature detection, and ring-
         # friendly (a cross-shard reduce, no CLS gather from one shard)
         pooled = jnp.max(x, axis=1)
@@ -121,11 +193,13 @@ class BertClassifier(nn.Module):
 
 def custom_model(hidden: int = 768, num_layers: int = 12, heads: int = 12,
                  mlp_dim: int = 3072, max_len: int = MAX_LEN,
-                 vocab_size: int = VOCAB_SIZE, moe_experts: int = 0):
+                 vocab_size: int = VOCAB_SIZE, moe_experts: int = 0,
+                 pipeline_microbatches: int = 0):
     return BertClassifier(
         vocab_size=vocab_size, hidden=hidden, num_layers=num_layers,
         heads=heads, mlp_dim=mlp_dim, max_len=max_len,
         moe_experts=moe_experts,
+        pipeline_microbatches=pipeline_microbatches,
     )
 
 
@@ -164,10 +238,15 @@ def eval_metrics_fn():
 
 
 def param_sharding(path, value):
-    """Sharded embedding tables over `model` + expert stacks over
-    `expert` (when moe_experts > 0); everything else replicated."""
+    """Sharded embedding tables over `model`, expert stacks over `expert`
+    (when moe_experts > 0), pipelined layer stacks over `pipe` (when
+    pipeline_microbatches > 0); everything else replicated."""
     from elasticdl_tpu.layers.moe import moe_param_sharding
+    from elasticdl_tpu.layers.pipeline import pipeline_param_sharding
 
+    spec = pipeline_param_sharding(path, value)
+    if spec is not None:
+        return spec
     spec = moe_param_sharding(path, value)
     if spec is not None:
         return spec

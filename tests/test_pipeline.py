@@ -1,0 +1,169 @@
+"""Pipeline parallelism (ops/pipeline.py + layers/pipeline.py).
+
+Validates the GPipe schedule the TPU-native way the suite validates ring
+attention: exact numerical equivalence (forward AND gradients) between
+the pipelined shard_map program and the plain sequential layer scan, on
+the virtual 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.ops.pipeline import _sequential, gpipe_spmd
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+
+def _mlp_stack(num_layers=8, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(num_layers, dim, dim) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.randn(num_layers, dim) * 0.1, jnp.float32),
+    }
+
+
+def _apply_one(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+class TestGPipeOp:
+    def test_forward_matches_sequential(self):
+        mesh = mesh_lib.create_mesh(jax.devices(), data=2, pipe=4)
+        stack = _mlp_stack()
+        x = jnp.asarray(np.random.RandomState(1).randn(16, 3, 8), jnp.float32)
+        ref = jax.jit(lambda s, xx: _sequential(_apply_one, s, xx))(stack, x)
+        out = jax.jit(
+            lambda s, xx: gpipe_spmd(
+                _apply_one, s, xx, mesh, num_microbatches=4
+            )
+        )(stack, x)
+        np.testing.assert_allclose(ref, out, atol=1e-5)
+
+    def test_gradients_match_sequential(self):
+        mesh = mesh_lib.create_mesh(jax.devices(), data=2, pipe=4)
+        stack = _mlp_stack()
+        x = jnp.asarray(np.random.RandomState(2).randn(8, 3, 8), jnp.float32)
+
+        def loss_ref(s, xx):
+            return (_sequential(_apply_one, s, xx) ** 2).sum()
+
+        def loss_pipe(s, xx):
+            return (
+                gpipe_spmd(_apply_one, s, xx, mesh, num_microbatches=4) ** 2
+            ).sum()
+
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(stack, x)
+        g_pipe = jax.jit(jax.grad(loss_pipe, argnums=(0, 1)))(stack, x)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_pipe)):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_pipe_axis_one_degenerates_to_scan(self):
+        mesh = mesh_lib.create_mesh(jax.devices(), data=8, pipe=1)
+        stack = _mlp_stack(num_layers=3)
+        x = jnp.asarray(np.random.RandomState(3).randn(8, 8), jnp.float32)
+        ref = _sequential(_apply_one, stack, x)
+        out = gpipe_spmd(_apply_one, stack, x, mesh, num_microbatches=4)
+        np.testing.assert_allclose(ref, out, atol=1e-6)
+
+    def test_remat_matches(self):
+        mesh = mesh_lib.create_mesh(jax.devices()[:4], data=1, pipe=4)
+        stack = _mlp_stack()
+        x = jnp.asarray(np.random.RandomState(4).randn(8, 8), jnp.float32)
+
+        def loss(s, xx, use_remat):
+            return (
+                gpipe_spmd(
+                    _apply_one, s, xx, mesh,
+                    num_microbatches=4, remat=use_remat,
+                ) ** 2
+            ).sum()
+
+        # static use_remat: jax.checkpoint inside shard_map requires jit
+        g_plain = jax.jit(jax.grad(loss), static_argnums=2)(stack, x, False)
+        g_remat = jax.jit(jax.grad(loss), static_argnums=2)(stack, x, True)
+        for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_rejects_indivisible_layers(self):
+        mesh = mesh_lib.create_mesh(jax.devices(), data=2, pipe=4)
+        stack = _mlp_stack(num_layers=6)
+        x = jnp.zeros((8, 8), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible by pipe"):
+            gpipe_spmd(_apply_one, stack, x, mesh, num_microbatches=4)
+
+
+class TestPipelinedBert:
+    def _spec(self, **extra):
+        import os
+
+        from elasticdl_tpu.common.model_handler import get_model_spec
+
+        zoo = os.path.join(os.path.dirname(__file__), "..", "model_zoo")
+        params = (
+            "hidden=32;num_layers=4;heads=2;mlp_dim=64;max_len=16;"
+            "vocab_size=64;pipeline_microbatches=4"
+        )
+        return get_model_spec(
+            zoo, "bert.bert_finetune.custom_model", model_params=params
+        )
+
+    def _batch(self, n=16, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "features": {
+                "input_ids": rng.randint(0, 64, size=(n, 16)).astype(
+                    np.int32
+                )
+            },
+            "labels": rng.randint(0, 2, n).astype(np.int32),
+        }
+
+    def test_trains_on_dp_pp_mesh(self):
+        from elasticdl_tpu.worker.trainer import Trainer
+
+        mesh = mesh_lib.create_mesh(jax.devices(), data=2, pipe=4)
+        spec = self._spec()
+        trainer = Trainer(
+            model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+            mesh=mesh, param_sharding_fn=spec.param_sharding,
+        )
+        batch = self._batch()
+        state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+        # layer stack is sharded over pipe on its leading axis
+        stack_leaf = state.params["params"]["encoder_pipeline"]["stack"]
+        leaf = jax.tree.leaves(stack_leaf)[0]
+        assert leaf.shape[0] == 4  # num_layers
+        spec_str = str(leaf.sharding.spec)
+        assert "pipe" in spec_str, spec_str
+        losses = []
+        for i in range(3):
+            state, loss = trainer.train_on_batch(state, self._batch(seed=i))
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+
+    def test_same_params_same_loss_on_pipe1_mesh(self):
+        """The SAME model config (stacked params) runs on a mesh with no
+        pipe axis — the schedule degenerates to a sequential scan and the
+        loss matches the pipelined mesh exactly (cross-mesh portability:
+        elastic remesh can move between pipelined and flat meshes)."""
+        from elasticdl_tpu.worker.trainer import Trainer
+
+        spec = self._spec()
+        batch = self._batch()
+        losses = {}
+        for name, mesh in {
+            "pp4": mesh_lib.create_mesh(jax.devices(), data=2, pipe=4),
+            "flat": mesh_lib.create_mesh(jax.devices(), data=8),
+        }.items():
+            trainer = Trainer(
+                model=spec.model, optimizer=spec.optimizer,
+                loss_fn=spec.loss, mesh=mesh,
+                param_sharding_fn=spec.param_sharding,
+            )
+            state = trainer.init_state(
+                jax.random.PRNGKey(0), batch["features"]
+            )
+            _, loss = trainer.train_on_batch(state, batch)
+            losses[name] = float(loss)
+        assert losses["pp4"] == pytest.approx(losses["flat"], abs=1e-4)
