@@ -41,6 +41,13 @@ def _train_local(args, job_type: str = "train") -> int:
     """Master + worker(s) in one process: the zero-cluster path (and the
     dev loop for model-zoo modules)."""
     from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.common.virtual_mesh import (
+        apply_compilation_cache_config,
+    )
+
+    apply_compilation_cache_config(
+        getattr(args, "compilation_cache_dir", "")
+    )
     from elasticdl_tpu.data.reader import create_data_reader
     from elasticdl_tpu.master.main import Master
     from elasticdl_tpu.proto.service import InProcessMasterClient
@@ -193,7 +200,11 @@ def _train_local(args, job_type: str = "train") -> int:
 
 def _submit_master_pod(args, job_type: str) -> int:
     """Cluster mode: create the master pod through the Kubernetes API."""
-    from elasticdl_tpu.common.k8s_client import K8sClient, PodSpec
+    from elasticdl_tpu.common.k8s_client import (
+        K8sClient,
+        PodSpec,
+        parse_volumes,
+    )
 
     master_args = args_lib.build_arguments_from_parsed_result(
         args, filter_args={"func"}
@@ -212,6 +223,7 @@ def _submit_master_pod(args, job_type: str) -> int:
             image=args.image_name,
             command=command,
             resources={},
+            volumes=parse_volumes(getattr(args, "volume", "")),
         )
     )
     # Worker pods dial `{job_name}-master:{port}`; that DNS name only

@@ -59,7 +59,13 @@ def add_common_params(parser: argparse.ArgumentParser):
     parser.add_argument("--worker_resource_limit", default="")
     parser.add_argument("--worker_pod_priority", default="")
     parser.add_argument("--restart_policy", default="Never")
-    parser.add_argument("--volume", default="")
+    parser.add_argument(
+        "--volume", default="",
+        help="Pod volume mounts, reference syntax: "
+        "'host_path=/a,mount_path=/b' or 'claim_name=pvc,mount_path=/b'; "
+        "multiple entries separated by ';'.  Mounted into the master pod "
+        "and every worker pod (e.g. the --compilation_cache_dir volume).",
+    )
     parser.add_argument("--image_pull_policy", default="IfNotPresent")
     parser.add_argument(
         "--need_tf_config", type=str2bool, default=False, nargs="?", const=True
@@ -88,6 +94,18 @@ def add_common_params(parser: argparse.ArgumentParser):
         help="Port of the JAX coordination service bound by rank 0; the "
         "rendezvous serves rank 0's address + this port as the "
         "coordinator address",
+    )
+    parser.add_argument(
+        "--compilation_cache_dir", default="",
+        help="Persistent XLA-executable cache directory.  A relaunched "
+        "worker then LOADS the train-step executable instead of "
+        "recompiling it, cutting elastic recovery by the ~20-40s compile "
+        "— the AOT mitigation SURVEY.md hard part 1 calls for.  Empty "
+        "disables.  Re-serialized into worker pod commands like every "
+        "flag; on a real cluster pair it with --volume so the directory "
+        "is a mount shared across pod relaunches (e.g. --volume "
+        "'claim_name=cache,mount_path=/cache' "
+        "--compilation_cache_dir /cache).",
     )
 
 

@@ -43,6 +43,56 @@ class PodSpec:
     resources: Dict[str, str] = field(default_factory=dict)
     priority_class: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
+    # parsed --volume entries (parse_volumes): each a dict with
+    # "mount_path" plus one of "host_path" / "claim_name"
+    volumes: List[Dict[str, str]] = field(default_factory=list)
+
+
+def parse_volumes(volume: str) -> List[Dict[str, str]]:
+    """Parse the --volume flag (reference syntax, SURVEY.md C21):
+    `host_path=/a,mount_path=/b` or `claim_name=pvc,mount_path=/b`;
+    multiple volumes separated by `;`.  The shared --compilation_cache_dir
+    volume rides this flag like any other mount."""
+    out: List[Dict[str, str]] = []
+    for part in (volume or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        entry: Dict[str, str] = {}
+        for kv in part.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"--volume entry {kv!r} is not key=value "
+                    "(expected host_path=/a,mount_path=/b or "
+                    "claim_name=pvc,mount_path=/b)"
+                )
+            key, _, value = kv.partition("=")
+            key, value = key.strip(), value.strip()
+            if key not in ("host_path", "claim_name", "mount_path"):
+                raise ValueError(
+                    f"--volume key {key!r} not supported (host_path, "
+                    "claim_name, mount_path)"
+                )
+            if not value:
+                raise ValueError(f"--volume key {key!r} has empty value")
+            entry[key] = value
+        if "host_path" in entry and "claim_name" in entry:
+            raise ValueError(
+                f"--volume entry {part!r} sets both host_path and "
+                "claim_name; pick one source"
+            )
+        if "mount_path" not in entry or not (
+            "host_path" in entry or "claim_name" in entry
+        ):
+            raise ValueError(
+                f"--volume entry {part!r} needs mount_path plus "
+                "host_path or claim_name"
+            )
+        out.append(entry)
+    return out
 
 
 class AbstractK8sClient:
@@ -337,6 +387,30 @@ class K8sClient(AbstractK8sClient):
 
     def create_pod(self, spec: PodSpec) -> None:
         client = self._client_mod
+        volumes, mounts = [], []
+        for i, entry in enumerate(spec.volumes):
+            vol_name = f"vol-{i}"
+            if "claim_name" in entry:
+                source = dict(
+                    persistent_volume_claim=(
+                        client.V1PersistentVolumeClaimVolumeSource(
+                            claim_name=entry["claim_name"]
+                        )
+                    )
+                )
+            else:
+                source = dict(
+                    host_path=client.V1HostPathVolumeSource(
+                        path=entry["host_path"],
+                        type="DirectoryOrCreate",
+                    )
+                )
+            volumes.append(client.V1Volume(name=vol_name, **source))
+            mounts.append(
+                client.V1VolumeMount(
+                    name=vol_name, mount_path=entry["mount_path"]
+                )
+            )
         container = client.V1Container(
             name="main",
             image=spec.image,
@@ -344,6 +418,7 @@ class K8sClient(AbstractK8sClient):
             resources=client.V1ResourceRequirements(
                 requests=spec.resources or None
             ),
+            volume_mounts=mounts or None,
         )
         pod = client.V1Pod(
             metadata=client.V1ObjectMeta(
@@ -359,6 +434,7 @@ class K8sClient(AbstractK8sClient):
                 containers=[container],
                 restart_policy="Never",
                 priority_class_name=spec.priority_class or None,
+                volumes=volumes or None,
             ),
         )
         self._core.create_namespaced_pod(self._namespace, pod)

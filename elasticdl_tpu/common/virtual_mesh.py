@@ -62,16 +62,22 @@ def apply_cpu_mesh_env(n_devices: int) -> None:
     os.environ.update(cpu_mesh_env(n_devices))
 
 
-def apply_compilation_cache_config() -> None:
+def apply_compilation_cache_config(cache_dir: "str | None" = None) -> None:
     """Late-apply the persistent-cache env vars to an already-imported jax.
 
     jax reads JAX_COMPILATION_CACHE_DIR once, at import; on hosts whose
     sitecustomize imports jax at interpreter start (this machine's does,
     to register the TPU plugin), env vars set afterwards by a conftest or
     a parent process are silently ignored.  Call this after jax import in
-    any entry point that wants the shared executable cache."""
+    any entry point that wants the shared executable cache.
+
+    `cache_dir` (the --compilation_cache_dir flag) overrides the env var:
+    an explicit flag is the job's configuration; the env var is harness
+    ambience."""
     import os
 
+    if cache_dir:
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
     cache = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if not cache:
         return

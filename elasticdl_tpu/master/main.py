@@ -14,6 +14,7 @@ from typing import Optional
 
 from elasticdl_tpu.common import args as args_lib
 from elasticdl_tpu.common.constants import GRPC_MAX_MESSAGE_LENGTH
+from elasticdl_tpu.common.k8s_client import parse_volumes
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
@@ -165,6 +166,7 @@ class Master:
                 priority_class=getattr(args, "worker_pod_priority", ""),
                 on_job_abort=self._on_job_abort,
                 recovery_clock=self.recovery_clock,
+                volumes=parse_volumes(getattr(args, "volume", "")),
             )
         self.servicer = MasterServicer(
             self.task_manager,
@@ -333,12 +335,12 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
     the full elastic stack (rendezvous + pod manager over a real — or with
     --use_fake_k8s an in-memory — Kubernetes client); tests may inject
     `k8s_client` directly."""
+    args = args_lib.parse_master_args(argv)
     from elasticdl_tpu.common.virtual_mesh import (
         apply_compilation_cache_config,
     )
 
-    apply_compilation_cache_config()
-    args = args_lib.parse_master_args(argv)
+    apply_compilation_cache_config(args.compilation_cache_dir)
     if k8s_client is None and args.distribution_strategy != "Local":
         if args.use_process_k8s:
             from elasticdl_tpu.common.k8s_client import ProcessK8sClient

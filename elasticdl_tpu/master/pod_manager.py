@@ -11,7 +11,7 @@ collectives), so `workers_per_group` models slice-granular groups.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from elasticdl_tpu.common.constants import PodStatus, PodType
 from elasticdl_tpu.common.k8s_client import AbstractK8sClient, PodSpec
@@ -35,6 +35,7 @@ class PodManager:
         priority_class: str = "",
         on_job_abort=None,
         recovery_clock=None,
+        volumes: Optional[List[Dict[str, str]]] = None,
     ):
         self._k8s = k8s_client
         self._tm = task_manager
@@ -46,6 +47,7 @@ class PodManager:
         self._relaunch_budget = relaunch_on_worker_failure
         self._resources = worker_resources or {}
         self._priority_class = priority_class
+        self._volumes = volumes or []
         # Fired when the last worker dies with its relaunch chain exhausted
         # — without it a fully-crashed job would hang the master forever.
         self._on_job_abort = on_job_abort or (lambda reason: None)
@@ -150,6 +152,7 @@ class PodManager:
             command=self._worker_command(worker_id),
             resources=self._resources,
             priority_class=self._priority_class,
+            volumes=self._volumes,
         )
         logger.info("Launching %s", pod_name)
         self._k8s.create_pod(spec)

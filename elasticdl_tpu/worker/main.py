@@ -85,14 +85,17 @@ def wait_for_membership(client, worker_id: int, poll_s: float = 0.5):
 
 
 def main(argv=None):
-    # honor a parent-provided persistent compile cache even though
-    # sitecustomize imported jax before our env was visible to it
+    args = args_lib.parse_worker_args(argv)
+    # honor the job's persistent compile cache (--compilation_cache_dir,
+    # or a parent-provided env var) even though sitecustomize imported
+    # jax before either was visible to it.  A relaunched worker then
+    # loads the train-step executable instead of recompiling — the
+    # biggest single chunk of elastic recovery time.
     from elasticdl_tpu.common.virtual_mesh import (
         apply_compilation_cache_config,
     )
 
-    apply_compilation_cache_config()
-    args = args_lib.parse_worker_args(argv)
+    apply_compilation_cache_config(args.compilation_cache_dir)
     worker_id = int(
         os.environ.get(WorkerEnv.WORKER_ID, args.worker_id)
     )
