@@ -31,6 +31,24 @@ NUM_DENSE = 13
 NUM_SPARSE = 26
 
 
+def field_offset_ids(sparse: jnp.ndarray) -> jnp.ndarray:
+    """(B, 26) raw ids -> field-offset ids for the ONE shared table:
+    separates fields before hashing (hash mixing declusters the
+    offsets).  Shared by every CTR model on this record format so the
+    id scheme cannot drift between them."""
+    offsets = jnp.arange(NUM_SPARSE, dtype=jnp.int32) * jnp.int32(
+        0x61C88647  # int32-safe odd mixing constant (2^32/phi >> 1)
+    )
+    return sparse.astype(jnp.int32) + offsets[None, :]
+
+
+def normalize_dense(dense: jnp.ndarray) -> jnp.ndarray:
+    """Signed log1p squashing of the 13 dense counters (Criteo-style
+    heavy-tailed counts)."""
+    dense = dense.astype(jnp.float32)
+    return jnp.log1p(jnp.abs(dense)) * jnp.sign(dense)
+
+
 class DeepFM(nn.Module):
     vocab_capacity: int = 1 << 18  # shared table rows (hash space)
     embed_dim: int = 16
@@ -43,14 +61,7 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features):
-        dense = features["dense"].astype(jnp.float32)      # (B, 13)
-        sparse = features["sparse"].astype(jnp.int32)      # (B, 26)
-        # field-offset ids so the shared table separates fields before
-        # hashing (hash mixing declusters the offsets)
-        offsets = jnp.arange(NUM_SPARSE, dtype=jnp.int32) * jnp.int32(
-            0x61C88647  # int32-safe odd mixing constant (2^32/phi >> 1)
-        )
-        field_ids = sparse + offsets[None, :]
+        field_ids = field_offset_ids(features["sparse"])   # (B, 26)
 
         # second-order / deep embeddings: (B, 26, k)
         emb = DistributedEmbedding(
@@ -66,7 +77,7 @@ class DeepFM(nn.Module):
         sum_f = jnp.sum(emb, axis=1)
         fm2 = 0.5 * jnp.sum(sum_f * sum_f - jnp.sum(emb * emb, axis=1), axis=-1)
 
-        dense_n = jnp.log1p(jnp.abs(dense)) * jnp.sign(dense)
+        dense_n = normalize_dense(features["dense"])       # (B, 13)
         wide = nn.Dense(1, name="dense_linear")(dense_n)[..., 0]
 
         deep_in = jnp.concatenate(

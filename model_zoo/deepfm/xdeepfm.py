@@ -1,0 +1,133 @@
+"""xDeepFM for Criteo-style CTR data — the reference zoo's second CTR
+model (SURVEY.md C20 lists DeepFM/xDeepFM).  Zoo-contract module sharing
+DeepFM's record format/feed, re-designed TPU-first:
+
+The Compressed Interaction Network (CIN) replaces the FM second-order
+term with explicit vector-wise high-order interactions.  The upstream
+formulation is a 1x1 conv over an outer-product tensor; here each layer
+is ONE einsum
+
+    X^k[b,h,d] = sum_{i,j} W^k[h,i,j] * X^{k-1}[b,i,d] * X0[b,j,d]
+
+which XLA contracts on the MXU without ever materialising the
+(B, H*m, D) outer-product tensor the conv formulation builds — the
+TPU-native shape of the same math.  Sum-pooling over d of every layer's
+feature maps feeds the final logit, alongside DeepFM's linear term and
+MLP tower.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.layers.embedding import (
+    DistributedEmbedding,
+    embedding_param_sharding,
+)
+from model_zoo.common.metrics import auc, binary_accuracy
+from model_zoo.deepfm.deepfm_functional_api import (
+    NUM_DENSE,
+    NUM_SPARSE,
+    RECORD_BYTES,
+    feed,
+    field_offset_ids,
+    loss,
+    normalize_dense,
+    optimizer,
+)
+
+__all__ = [
+    "custom_model", "loss", "optimizer", "feed", "eval_metrics_fn",
+    "param_sharding", "RECORD_BYTES", "NUM_DENSE", "NUM_SPARSE",
+]
+
+
+class CIN(nn.Module):
+    """Compressed Interaction Network over field embeddings (B, m, D)."""
+
+    layer_widths: tuple = (64, 64)
+
+    @nn.compact
+    def __call__(self, x0):
+        fields = x0.shape[1]
+        pooled = []
+        xk = x0
+        for li, width in enumerate(self.layer_widths):
+            w = self.param(
+                f"w_{li}",
+                nn.initializers.glorot_uniform(),
+                (width, xk.shape[1], fields),
+            )
+            # one fused contraction per layer; f32 accumulation on the MXU
+            xk = jnp.einsum(
+                "hij,bid,bjd->bhd", w, xk, x0,
+                preferred_element_type=jnp.float32,
+            )
+            xk = nn.relu(xk)
+            pooled.append(jnp.sum(xk, axis=-1))        # (B, width)
+        return jnp.concatenate(pooled, axis=-1)
+
+
+class XDeepFM(nn.Module):
+    vocab_capacity: int = 1 << 18
+    embed_dim: int = 16
+    cin_widths: tuple = (64, 64)
+    mlp_dims: tuple = (256, 128)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, features):
+        field_ids = field_offset_ids(features["sparse"])   # (B, 26)
+
+        emb = DistributedEmbedding(
+            self.vocab_capacity, self.embed_dim, hash_input=True,
+            name="fm_embedding",
+        )(field_ids)                                        # (B, 26, k)
+        first = DistributedEmbedding(
+            self.vocab_capacity, 1, hash_input=True, name="fm_linear",
+        )(field_ids)
+
+        cin_out = CIN(self.cin_widths, name="cin")(emb)
+        cin_logit = nn.Dense(1, name="cin_out")(cin_out)[..., 0]
+
+        dense_n = normalize_dense(features["dense"])       # (B, 13)
+        wide = nn.Dense(1, name="dense_linear")(dense_n)[..., 0]
+
+        deep_in = jnp.concatenate(
+            [dense_n, emb.reshape(emb.shape[0], -1)], axis=-1
+        )
+        h = deep_in.astype(self.compute_dtype)
+        for i, width in enumerate(self.mlp_dims):
+            h = nn.relu(
+                nn.Dense(
+                    width, name=f"mlp_{i}", dtype=self.compute_dtype
+                )(h)
+            )
+        deep = nn.Dense(1, name="mlp_out", dtype=self.compute_dtype)(h)[
+            ..., 0
+        ].astype(jnp.float32)
+
+        return wide + jnp.sum(first[..., 0], axis=1) + cin_logit + deep
+
+
+def custom_model(
+    vocab_capacity: int = 1 << 18,
+    embed_dim: int = 16,
+    bf16: bool = False,
+    cin_widths: tuple = (64, 64),
+):
+    return XDeepFM(
+        vocab_capacity=vocab_capacity,
+        embed_dim=embed_dim,
+        cin_widths=tuple(cin_widths),
+        compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+    )
+
+
+def eval_metrics_fn():
+    return {"auc": auc, "accuracy": binary_accuracy}
+
+
+param_sharding = embedding_param_sharding

@@ -1,0 +1,43 @@
+"""MNIST CNN, subclass style — the reference zoo ships BOTH a
+functional-API and a subclass (custom `call`) MNIST model (SURVEY.md
+C20); this is the subclass variant.  The Flax analogue of a Keras
+subclass model is an explicit `setup()` declaring layers as attributes
+with `__call__` as the imperative forward — same contract surface and
+record format as mnist_functional_api (feed/loss/... re-exported)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from model_zoo.mnist.mnist_functional_api import (  # noqa: F401
+    eval_metrics_fn,
+    feed,
+    loss,
+    optimizer,
+)
+
+__all__ = ["custom_model", "loss", "optimizer", "feed", "eval_metrics_fn"]
+
+
+class MnistSubclassCNN(nn.Module):
+    hidden: int = 128
+
+    def setup(self):
+        self.conv1 = nn.Conv(32, (3, 3))
+        self.conv2 = nn.Conv(64, (3, 3))
+        self.fc1 = nn.Dense(self.hidden)
+        self.fc2 = nn.Dense(10)
+
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], 28, 28, 1)
+        x = nn.relu(self.conv1(x))
+        x = nn.relu(self.conv2(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(self.fc1(x))
+        return self.fc2(x)  # logits
+
+
+def custom_model(hidden: int = 128):
+    return MnistSubclassCNN(hidden=hidden)

@@ -1,0 +1,89 @@
+"""xDeepFM (CIN) and the MNIST subclass-API zoo variants (SURVEY.md C20:
+the reference zoo ships DeepFM/xDeepFM and functional+subclass MNIST)."""
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.worker.trainer import Trainer
+
+ZOO = "model_zoo"
+
+
+def test_xdeepfm_learns_planted_structure():
+    from model_zoo.common.metrics import auc as auc_fn
+    from model_zoo.deepfm.data import synthetic_criteo
+
+    spec = get_model_spec(
+        ZOO, "deepfm.xdeepfm.custom_model",
+        model_params="vocab_capacity=65536;embed_dim=8;cin_widths=(16,16)",
+    )
+    mesh = mesh_lib.create_mesh(jax.devices(), data=4, model=2)
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+        mesh=mesh, param_sharding_fn=spec.param_sharding,
+    )
+    batch_size, steps = 512, 24
+    dense, sparse, labels = synthetic_criteo(steps * batch_size, seed=0)
+    state = trainer.init_state(
+        jax.random.PRNGKey(0),
+        {"dense": dense[:batch_size], "sparse": sparse[:batch_size]},
+    )
+    first_loss = last_loss = None
+    for i in range(steps):
+        sl = slice(i * batch_size, (i + 1) * batch_size)
+        state, loss = trainer.train_on_batch(
+            state,
+            {
+                "features": {"dense": dense[sl], "sparse": sparse[sl]},
+                "labels": labels[sl].astype(np.int32),
+            },
+        )
+        if first_loss is None:
+            first_loss = float(loss)
+        last_loss = float(loss)
+    assert last_loss < first_loss, (first_loss, last_loss)
+    # embedding tables row-sharded over `model` like DeepFM's
+    table = state.params["params"]["fm_embedding"]["embedding"]
+    assert "model" in str(table.sharding.spec)
+    vd, vs, vy = synthetic_criteo(4096, seed=999)
+    preds = trainer.predict_on_batch(state, {"dense": vd, "sparse": vs})
+    assert auc_fn(vy, preds) > 0.65
+
+
+def test_xdeepfm_shares_deepfm_record_format():
+    import model_zoo.deepfm.deepfm_functional_api as deepfm
+    import model_zoo.deepfm.xdeepfm as xdeepfm
+
+    assert xdeepfm.RECORD_BYTES == deepfm.RECORD_BYTES
+    rng = np.random.RandomState(0)
+    rec = (
+        rng.rand(13).astype(np.float32).tobytes()
+        + rng.randint(0, 1 << 20, 26).astype(np.int32).tobytes()
+        + bytes([1])
+    )
+    fed = xdeepfm.feed([rec])
+    assert fed["features"]["dense"].shape == (1, 13)
+    assert fed["features"]["sparse"].shape == (1, 26)
+    assert fed["labels"][0] == 1
+
+
+def test_mnist_subclass_trains():
+    spec = get_model_spec(
+        ZOO, "mnist.mnist_subclass.custom_model", model_params="hidden=64"
+    )
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(32, 784).astype(np.float32),
+        "labels": rng.randint(0, 10, 32).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    losses = []
+    for _ in range(12):  # memorize the fixed batch
+        state, loss = trainer.train_on_batch(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
