@@ -61,6 +61,9 @@ def _train_local(args, job_type: str = "train") -> int:
         loss=args.loss,
         optimizer=args.optimizer,
         eval_metrics_fn=args.eval_metrics_fn,
+        prediction_outputs_processor=getattr(
+            args, "prediction_outputs_processor", ""
+        ),
     )
     args.job_type = job_type
     if job_type in ("evaluate", "predict") and not args.checkpoint_dir_for_init:
@@ -175,23 +178,31 @@ def _train_local(args, job_type: str = "train") -> int:
     if job_type == "predict" and args.output:
         import numpy as np
 
-        preds = [
-            p for w in workers
-            for p in getattr(w, "predictions", [])
-        ]
-        if preds:
+        # per-task arrays keyed by task_id (rerun-safe); merge in task
+        # order so the row order is deterministic across runs
+        by_task = {}
+        for w in workers:
+            by_task.update(getattr(w, "predictions", {}) or {})
+        if by_task:
             os_path = args.output
             if not os_path.endswith(".npy"):
                 import os
 
                 os.makedirs(os_path, exist_ok=True)
                 os_path = f"{os_path}/predictions.npy"
-            np.save(os_path, np.concatenate(preds))
+            np.save(
+                os_path,
+                np.concatenate([by_task[t] for t in sorted(by_task)]),
+            )
             logger.info("Wrote predictions to %s", os_path)
     elif args.output and owner.state is not None:
         from elasticdl_tpu.common.export import export_model
 
-        export_model(owner.state, spec, args.output)
+        export_model(
+            owner.state, spec, args.output,
+            saved_model=bool(getattr(args, "export_saved_model", False)),
+            sample_features=owner.sample_features,
+        )
         logger.info("Exported model to %s", args.output)
     logger.info("Job %s: %s", "succeeded" if ok else "failed",
                 master.task_manager.snapshot())

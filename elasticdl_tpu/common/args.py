@@ -144,6 +144,15 @@ def add_train_params(parser: argparse.ArgumentParser):
     parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=3)
     parser.add_argument("--output", default="", help="final model export dir")
     parser.add_argument(
+        "--export_saved_model", type=str2bool, default=False, nargs="?",
+        const=True,
+        help="Also export a TF SavedModel under <output>/saved_model "
+        "(forward pass staged via jax2tf, polymorphic batch dim) — the "
+        "serving handoff the reference's SavedModel export provided.  "
+        "Mesh-manual models (ring attention / GPipe) do not convert; the "
+        "msgpack export is always written regardless.",
+    )
+    parser.add_argument(
         "--checkpoint_dir_for_init", default="",
         help="checkpoint to warm-start from",
     )

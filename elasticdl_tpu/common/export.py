@@ -6,9 +6,16 @@ back to `keras.Embedding` before export; here the sharded tables are
 ordinary arrays in the param tree, so export is a gather-to-host plus
 serialization — no layer rewrite needed.
 
-Format: `params.msgpack` (flax serialization of {params, model_state}) +
-`export_meta.json` (module/model info for reloading).  Re-load with
-`load_exported` into a freshly constructed zoo model.
+Formats:
+- `params.msgpack` (flax serialization of {params, model_state}) +
+  `export_meta.json` (module/model info) — always written; re-load with
+  `load_exported` into a freshly constructed zoo model.
+- `saved_model/` — optional TF SavedModel (`--export_saved_model`): the
+  model's forward pass staged through jax2tf with a polymorphic batch
+  dimension, params embedded as tf.Variables, serving signature named
+  after the feature keys.  This is the serving handoff the reference's
+  SavedModel export provided; any TF Serving stack consumes it with no
+  JAX at inference time.
 """
 
 from __future__ import annotations
@@ -26,7 +33,13 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger(__name__)
 
 
-def export_model(state, spec, output_dir: str) -> str:
+def export_model(
+    state,
+    spec,
+    output_dir: str,
+    saved_model: bool = False,
+    sample_features: Any = None,
+) -> str:
     os.makedirs(output_dir, exist_ok=True)
     host_tree = {
         "params": jax.tree.map(np.asarray, state.params),
@@ -43,7 +56,94 @@ def export_model(state, spec, output_dir: str) -> str:
     }
     with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
         json.dump(meta, f, indent=2)
+    if saved_model:
+        if sample_features is None:
+            # raise so export_for_task re-queues to a worker that HAS
+            # processed a batch — a silent skip would let the job report
+            # success with <output>/saved_model never written (the same
+            # discipline worker.export_for_task applies to missing state)
+            raise RuntimeError(
+                "SavedModel export requested but this worker captured no "
+                "sample features (no batch ever reached it); re-queueing"
+            )
+        try:
+            export_saved_model(
+                state, spec, os.path.join(output_dir, "saved_model"),
+                sample_features,
+            )
+        except Exception as exc:
+            # mesh-manual models (ring attention / GPipe shard_map) do
+            # not stage through jax2tf; the msgpack export above is
+            # still valid, so surface the failure without killing a
+            # finished training job
+            logger.error(
+                "SavedModel export failed (%s); wrote params.msgpack "
+                "only", exc,
+            )
     return path
+
+
+def export_saved_model(
+    state, spec, output_dir: str, sample_features: Any
+) -> str:
+    """Stage the model's forward pass into a TF SavedModel via jax2tf.
+
+    sample_features: one host batch of features (any batch size) — used
+    only for structure/shape/dtype of the serving signature; the batch
+    dimension is exported polymorphic.
+    """
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    model = spec.model
+    variables = {
+        **jax.tree.map(np.asarray, state.params),
+        **jax.tree.map(np.asarray, state.model_state),
+    }
+    from elasticdl_tpu.worker.trainer import model_has_train_kwarg
+
+    has_train = model_has_train_kwarg(model)
+
+    def apply_fn(variables, features):
+        kwargs = {"train": False} if has_train else {}
+        return model.apply(variables, features, **kwargs)
+
+    def poly_spec(x):
+        nd = np.ndim(x)
+        inner = (", " + ", ".join(["_"] * (nd - 1))) if nd > 1 else ""
+        return f"(b{inner})"
+
+    tf_fn = jax2tf.convert(
+        apply_fn,
+        polymorphic_shapes=[None, jax.tree.map(poly_spec, sample_features)],
+        with_gradient=False,
+    )
+    module = tf.Module()
+    module.v = tf.nest.map_structure(tf.Variable, variables)
+
+    def leaf_spec(value, name):
+        value = np.asarray(value)
+        return tf.TensorSpec(
+            (None,) + value.shape[1:], value.dtype, name=name
+        )
+
+    if isinstance(sample_features, dict):
+        signature = {
+            k: leaf_spec(v, k) for k, v in sample_features.items()
+        }
+    else:
+        signature = leaf_spec(sample_features, "features")
+
+    @tf.function(autograph=False)
+    def serve(features):
+        return tf_fn(module.v, features)
+
+    concrete = serve.get_concrete_function(signature)
+    tf.saved_model.save(
+        module, output_dir, signatures={"serving_default": concrete}
+    )
+    logger.info("Exported TF SavedModel to %s", output_dir)
+    return output_dir
 
 
 def load_exported(output_dir: str, template: Any):

@@ -45,6 +45,9 @@ class ModelSpec:
     custom_data_reader: Optional[Callable] = None
     callbacks: list = field(default_factory=list)
     param_sharding: Optional[Callable] = None
+    # reference C18 surface: an object with process(predictions, worker_id)
+    # invoked on each prediction batch (e.g. streaming rows to a sink)
+    prediction_outputs_processor: Any = None
     module: Any = None
 
 
@@ -93,6 +96,7 @@ def get_model_spec(
     eval_metrics_fn: str = "eval_metrics_fn",
     custom_data_reader: str = "custom_data_reader",
     callbacks: str = "callbacks",
+    prediction_outputs_processor: str = "",
 ) -> ModelSpec:
     module, model_fn = load_module(model_zoo, model_def)
 
@@ -108,6 +112,16 @@ def get_model_spec(
     metrics_factory = opt(eval_metrics_fn, required=False)
     reader_factory = opt(custom_data_reader, required=False)
     callbacks_factory = opt(callbacks, required=False)
+    processor = None
+    if prediction_outputs_processor:
+        processor_cls = getattr(module, prediction_outputs_processor, None)
+        if processor_cls is None:
+            raise ValueError(
+                f"--prediction_outputs_processor "
+                f"{prediction_outputs_processor!r} not found in "
+                f"{module.__name__}"
+            )
+        processor = _call_with_params(processor_cls, model_params)
     return ModelSpec(
         model=_call_with_params(model_fn, model_params),
         loss=opt(loss),
@@ -117,5 +131,6 @@ def get_model_spec(
         custom_data_reader=reader_factory,
         callbacks=callbacks_factory() if callbacks_factory else [],
         param_sharding=getattr(module, "param_sharding", None),
+        prediction_outputs_processor=processor,
         module=module,
     )

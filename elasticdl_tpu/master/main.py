@@ -204,7 +204,12 @@ class Master:
         self._save_model_done = True
         import json
 
-        rider = json.dumps({"output": self.args.output})
+        rider = json.dumps({
+            "output": self.args.output,
+            "saved_model": bool(
+                getattr(self.args, "export_saved_model", False)
+            ),
+        })
         return [(pb.Shard(), pb.SAVE_MODEL, -1, rider)]
 
     # ---- lifecycle -----------------------------------------------------

@@ -109,6 +109,9 @@ def main(argv=None):
         loss=args.loss,
         optimizer=args.optimizer,
         eval_metrics_fn=args.eval_metrics_fn,
+        prediction_outputs_processor=getattr(
+            args, "prediction_outputs_processor", ""
+        ),
     )
     if spec.custom_data_reader is not None:
         reader = spec.custom_data_reader(data_origin=args.training_data)

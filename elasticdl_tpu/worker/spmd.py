@@ -219,6 +219,11 @@ class SPMDWorker:
         )
 
     def _ensure_state(self, batch) -> None:
+        if getattr(self, "sample_features", None) is None:
+            # one host row, kept for export signatures (SavedModel)
+            self.sample_features = jax.tree.map(
+                lambda a: np.asarray(a[:1]), batch["features"]
+            )
         if self.state is not None:
             return
         self.state = self.trainer.init_state_global(
@@ -389,7 +394,12 @@ class SPMDWorker:
                 # state (deterministic across ranks) => report failure so
                 # the task re-queues instead of silently skipping.
                 try:
-                    export_for_task(self.state, self.spec, task)
+                    export_for_task(
+                        self.state, self.spec, task,
+                        sample_features=getattr(
+                            self, "sample_features", None
+                        ),
+                    )
                 except RuntimeError as exc:
                     self._data_service.report_task(task, err=str(exc))
                 else:
@@ -495,6 +505,7 @@ class SPMDWorker:
     def _predict_task(self, task: pb.Task) -> int:
         records = 0
         rows = []
+        processor = self.spec.prediction_outputs_processor
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
@@ -506,6 +517,10 @@ class SPMDWorker:
                 self.trainer.predict_on_global_batch(self.state, features)
             )
             rows.append(np.asarray(preds)[:real])
+            if processor is not None and self.is_leader:
+                # reference C18 contract; leader-only so the zoo's sink
+                # sees each batch once, not once per rank
+                processor.process(rows[-1], self.worker_id)
             records += real
         if rows:
             # Keyed by task_id so a task re-processed after a remesh (the

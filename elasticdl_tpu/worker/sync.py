@@ -45,6 +45,7 @@ class ModelOwner:
         self.trainer = trainer
         self.lock = threading.RLock()
         self.state = None
+        self.sample_features = None
         self._rng = jax.random.PRNGKey(seed)
         self.checkpoint_saver = checkpoint_saver
         self.checkpoint_steps = checkpoint_steps
@@ -53,6 +54,14 @@ class ModelOwner:
 
     def ensure_state(self, batch) -> None:
         with self.lock:
+            if self.sample_features is None:
+                # one host row, kept for export signatures (SavedModel
+                # needs the feature structure/shapes/dtypes)
+                import numpy as np
+
+                self.sample_features = jax.tree.map(
+                    lambda a: np.asarray(a[:1]), batch["features"]
+                )
             if self.state is not None:
                 return
             self.state = self.trainer.init_state(

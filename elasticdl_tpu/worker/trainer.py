@@ -55,6 +55,18 @@ def run_device_serialized(fn, *args):
         return jax.block_until_ready(fn(*args))
 
 
+def model_has_train_kwarg(model) -> bool:
+    """Whether the model's __call__ takes the zoo contract's `train`
+    kwarg (BatchNorm/dropout models).  Shared by the Trainer and the
+    SavedModel export so train-time eval and serving stay in lockstep."""
+    import inspect
+
+    try:
+        return "train" in inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def _sown_aux_loss(intermediates) -> jnp.ndarray:
     """Sum every `moe_aux_loss` value sown anywhere in the module tree
     (already scaled by its coefficient at sow time).  Zero when nothing
@@ -102,13 +114,7 @@ class Trainer:
         self._data = mesh_lib.data_sharding(self.mesh)
         # Models with train-time behavior (BatchNorm, dropout) take a
         # `train` kwarg per the zoo contract; plain models need not.
-        import inspect
-
-        try:
-            call_params = inspect.signature(type(model).__call__).parameters
-            self._has_train_kwarg = "train" in call_params
-        except (TypeError, ValueError):
-            self._has_train_kwarg = False
+        self._has_train_kwarg = model_has_train_kwarg(model)
         self._build_steps()
 
     def set_mesh(self, mesh):

@@ -217,7 +217,10 @@ class Worker:
         self._owner.save(force=True)
         # snapshot: another worker thread may still be training (and
         # donating the live state's buffers) while the export reads it
-        export_for_task(self._owner.snapshot(), self.spec, task)
+        export_for_task(
+            self._owner.snapshot(), self.spec, task,
+            sample_features=self._owner.sample_features,
+        )
 
     def _train_task(self, task: pb.Task) -> int:
         if self._profile_dir and not self._profiled:
@@ -309,13 +312,24 @@ class Worker:
 
     def _predict_task(self, task: pb.Task) -> int:
         records = 0
-        self.predictions = getattr(self, "predictions", [])
+        # keyed by task_id and only committed on task completion: a
+        # mid-task failure + re-queue must not leave partial rows that a
+        # rerun would duplicate (the SPMD path keys the same way)
+        self.predictions = getattr(self, "predictions", {})
+        processor = self.spec.prediction_outputs_processor
+        rows = []
         for batch, real in self._data_service.batches_for_task(
             task, self.minibatch_size, self._feed
         ):
             preds = self._owner.predict_batch(batch)
-            self.predictions.append(preds[:real])
+            rows.append(preds[:real])
+            if processor is not None:
+                # reference C18 contract: stream each prediction batch to
+                # the zoo's sink (raising fails + re-queues the task)
+                processor.process(preds[:real], self.worker_id)
             records += real
+        if rows:
+            self.predictions[task.task_id] = np.concatenate(rows)
         return records
 
     def _maybe_remesh(self):
@@ -335,30 +349,32 @@ class Worker:
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))
 
 
-def _task_output_dir(task: pb.Task) -> str:
-    """Extract the export dir from a SAVE_MODEL task's JSON config rider."""
+def _task_export_config(task: pb.Task) -> dict:
+    """Parse a SAVE_MODEL task's JSON config rider ({output, saved_model})."""
     if not task.extended_config:
-        return ""
+        return {}
     import json
 
     try:
-        return json.loads(task.extended_config).get("output", "")
+        return json.loads(task.extended_config)
     except ValueError:
         logger.warning(
             "Bad extended_config on task %d: %r",
             task.task_id, task.extended_config,
         )
-        return ""
+        return {}
 
 
-def export_for_task(state, spec, task: pb.Task) -> bool:
+def export_for_task(state, spec, task: pb.Task,
+                    sample_features=None) -> bool:
     """Export the model if the SAVE_MODEL task's rider names an output dir.
 
     Raises when an export was requested but there is no trained state —
     a silent skip would let the job report success with args.output never
     written; raising re-queues the task for a worker that has state.
     """
-    output = _task_output_dir(task)
+    config = _task_export_config(task)
+    output = config.get("output", "")
     if not output:
         return False
     if state is None:
@@ -368,6 +384,10 @@ def export_for_task(state, spec, task: pb.Task) -> bool:
         )
     from elasticdl_tpu.common.export import export_model
 
-    export_model(state, spec, output)
+    export_model(
+        state, spec, output,
+        saved_model=bool(config.get("saved_model", False)),
+        sample_features=sample_features,
+    )
     logger.info("Exported model to %s", output)
     return True

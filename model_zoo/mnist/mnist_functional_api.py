@@ -67,3 +67,15 @@ def eval_metrics_fn():
             np.mean(np.argmax(predictions, axis=-1) == labels)
         ),
     }
+
+
+class PredictionOutputsProcessor:
+    """Reference C18 surface (--prediction_outputs_processor): invoked
+    with every prediction batch.  This example collects them in memory; a
+    production processor would stream rows to a sink (table, queue)."""
+
+    def __init__(self):
+        self.batches = []
+
+    def process(self, predictions, worker_id):
+        self.batches.append((worker_id, np.asarray(predictions)))

@@ -29,3 +29,61 @@ def test_expressions_do_not_execute():
 def test_unknown_keys_filtered():
     out = _call_with_params(_fn, "a=1;zzz=9")
     assert out == {"a": 1, "b": None, "c": None}
+
+
+def test_prediction_outputs_processor_loaded_and_invoked():
+    """--prediction_outputs_processor (reference C18): the named zoo class
+    is instantiated into the spec and receives every prediction batch."""
+    import numpy as np
+    import jax
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.data.reader import MemoryDataReader
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.worker import Worker
+
+    spec = get_model_spec(
+        "model_zoo", "mnist.mnist_functional_api.custom_model",
+        prediction_outputs_processor="PredictionOutputsProcessor",
+    )
+    assert spec.prediction_outputs_processor is not None
+
+    rng = np.random.RandomState(0)
+    reader = MemoryDataReader({
+        "image": rng.rand(24, 784).astype(np.float32) * 255.0,
+        "label": rng.randint(0, 10, 24).astype(np.int32),
+    })
+
+    class Client:
+        def report_task_result(self, req):
+            pass
+
+    worker = Worker(
+        worker_id=3,
+        master_client=Client(),
+        data_reader=reader,
+        spec=spec,
+        minibatch_size=8,
+    )
+    task = pb.Task(
+        task_id=1,
+        shard=pb.Shard(name="mem", start=0, end=24),
+        type=pb.PREDICTION,
+    )
+    records = worker._predict_task(task)
+    assert records == 24
+    processor = spec.prediction_outputs_processor
+    assert sum(len(b) for _, b in processor.batches) == 24
+    assert all(wid == 3 for wid, _ in processor.batches)
+
+
+def test_missing_processor_name_raises():
+    import pytest
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+
+    with pytest.raises(ValueError, match="not found"):
+        get_model_spec(
+            "model_zoo", "mnist.mnist_functional_api.custom_model",
+            prediction_outputs_processor="NoSuchProcessor",
+        )
