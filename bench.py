@@ -137,28 +137,34 @@ def bench_deepfm(iters: int = 30):
     best = None
     state = None
     # Device-honest timing throughout (timed_steps_per_sec_fused): a
-    # fused on-device loop with a scalar output, value-fetch synced.
+    # fused on-device loop returning the step counter PLUS a
+    # params-derived anchor (without the anchor XLA DCEs the training
+    # chain and the loop times one round trip), value-fetch synced.
     # Rounds 1-2 timed per-call async dispatch, which on this tunneled
     # device over-reports by large factors — those BENCH numbers are not
     # comparable.
     # two points only: each size costs a fresh ~40s XLA compile, and the
-    # driver runs this under a wall-clock budget (throughput scales
-    # near-linearly with batch here — the step is latency-bound — so the
-    # largest memory-feasible batch wins)
-    for batch_size in (65536, 131072):
+    # driver runs this under a wall-clock budget.  The step is
+    # embedding-gather-bound (cost ~linear in ids = 26*batch), so
+    # throughput is roughly flat in batch with mild regime effects —
+    # measured honestly, the mid sizes win (the old large-batch sweep
+    # points were chosen on DCE-inflated numbers).  Median-of-3 per
+    # sweep point: one noisy sample must not pick the regime winner.
+    for batch_size in (16384, 65536):
         batch = _make_criteo_batch(batch_size)
         state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
-        steps_per_sec = trainer.timed_steps_per_sec_fused(
-            state, batch, iters=iters
-        )
-        examples_per_sec = steps_per_sec * batch_size
+        point = sorted(
+            trainer.timed_steps_per_sec_fused(state, batch, iters=iters)
+            for _ in range(3)
+        )[1]
+        examples_per_sec = point * batch_size
         sweep[batch_size] = round(examples_per_sec, 1)
         if best is None or examples_per_sec > best[1]:
-            best = (batch_size, examples_per_sec, steps_per_sec)
+            best = (batch_size, examples_per_sec, point)
     batch_size = best[0]
-    # median-of-5 at the winning batch (tunnel contention is real noise:
-    # observed repeats spanning 25-40M ex/s in one run; each repeat is
-    # compile-free so the extra two cost seconds)
+    # median-of-5 at the winning batch (tunnel contention is real noise —
+    # honest repeats span roughly 330-365K ex/s run to run; each repeat
+    # is compile-free so the extra runs cost seconds)
     batch = _make_criteo_batch(batch_size)
     state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
     repeats = [
@@ -234,21 +240,26 @@ def bench_deepfm(iters: int = 30):
 
     detail["auc_synthetic_criteo"] = round(_deepfm_auc(), 4)
     detail["timing_method"] = (
-        "fused on-device fori_loop, scalar output, value-fetch synced; "
-        "r01/r02 used per-call async dispatch timing which over-reports "
-        "on this device and is NOT comparable"
+        "fused on-device fori_loop, step-counter + params-anchor "
+        "outputs, value-fetch synced.  The anchor matters: without a "
+        "params-derived output XLA DCEs the whole training chain and "
+        "the loop times one device round trip regardless of iters "
+        "(verified 8-vs-32-iter identical totals).  r01/r02 per-call "
+        "dispatch timing and any anchor-less fused numbers are NOT "
+        "comparable."
     )
-    # The reference publishes nothing (BASELINE.json published: {}); the
-    # operative baseline is round 2's recorded 8.24M ex/s — measured with
-    # the old dispatch-timing method, so the ratio UNDERSTATES this
-    # round's real improvement (same method on today's code reads far
-    # higher than 8.24M).
-    r02 = 8_240_000.0
+    # The reference publishes nothing (BASELINE.json published: {}), so
+    # vs_baseline is 1.0 by definition (as in r01/r02).  Cross-round
+    # context lives in detail: r01/r02's recorded 8.24M ex/s and this
+    # round's earlier 26-46M figures were measurement artifacts (async
+    # dispatch timing / DCE'd fused loops — see timing_method); the
+    # honest number is NOT comparable to any of them.
+    detail["r02_recorded_examples_per_sec_not_comparable"] = 8_240_000.0
     return {
         "metric": "deepfm_criteo_train_examples_per_sec",
         "value": round(examples_per_sec, 1),
         "unit": "examples/sec",
-        "vs_baseline": round(examples_per_sec / r02, 3),
+        "vs_baseline": 1.0,
         "detail": detail,
     }
 

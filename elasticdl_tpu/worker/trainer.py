@@ -324,18 +324,26 @@ class Trainer:
 
     def timed_steps_per_sec_fused(self, state, batch, iters: int = 40):
         """Device-honest step rate: ONE jitted program runs `iters`
-        serially-dependent train steps via lax.fori_loop and returns only
-        the scalar step counter, synced with a value fetch.
+        serially-dependent train steps via lax.fori_loop and returns two
+        scalars — the step counter AND an anchor folded from the final
+        params — synced with a value fetch.
 
         Why not time per-call dispatch (a Python loop over train_step
-        with block_until_ready)?  Measured
-        pitfalls on remote/tunneled devices: (a) async dispatch makes
-        block_until_ready under-report badly — the loop can time Python
-        dispatch, not device work (observed >100% "MFU"); (b) returning
-        the full TrainState from the timed program makes the runtime
-        stage hundreds of MB per call (observed 30x slowdown).  A fused
-        loop with a scalar output measures exactly iters on-device steps
-        plus one round trip."""
+        with block_until_ready)?  Measured pitfalls on remote/tunneled
+        devices: (a) async dispatch makes block_until_ready under-report
+        badly — the loop can time Python dispatch, not device work
+        (observed >100% "MFU"); (b) returning the full TrainState from
+        the timed program makes the runtime stage hundreds of MB per
+        call (observed 30x slowdown).
+
+        The params ANCHOR is load-bearing: returning only the step
+        counter lets XLA's while-loop simplifier dead-code-eliminate the
+        entire training chain (step+1 does not depend on params), and
+        the 'measured' loop then costs one device round trip regardless
+        of iters — verified on this machine (8 vs 32 iters: identical
+        ~95ms totals; with the anchor: 22.9ms per real step).  A scalar
+        folded from the final params forces every iteration's
+        forward+backward+update to execute."""
         batch = mesh_lib.shard_batch(batch, self.mesh)
         cache = getattr(self, "_fused_timing_cache", None)
         if cache is None:
@@ -348,7 +356,16 @@ class Trainer:
                 def body(_, s2):
                     s3, _loss = self.train_step(s2, b)
                     return s3
-                return jax.lax.fori_loop(0, iters, body, s).step
+
+                out = jax.lax.fori_loop(0, iters, body, s)
+                # every param leaf: anchoring a subset would let the
+                # partitioner prune the unused leaves' gradient/update
+                # branches (Adam state chains stay live through params)
+                anchor = sum(
+                    leaf.ravel()[0].astype(jnp.float32)
+                    for leaf in jax.tree.leaves(out.params)
+                )
+                return out.step, anchor
 
             fused = cache[iters] = jax.jit(multi)
         jax.device_get(fused(state, batch))  # compile + warm
