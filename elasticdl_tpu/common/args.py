@@ -132,7 +132,13 @@ def add_model_params(parser: argparse.ArgumentParser):
 def add_train_params(parser: argparse.ArgumentParser):
     parser.add_argument("--minibatch_size", type=pos_int, default=64)
     parser.add_argument("--num_epochs", type=pos_int, default=1)
-    parser.add_argument("--grads_to_wait", type=pos_int, default=1)
+    parser.add_argument(
+        "--grads_to_wait", type=pos_int, default=1,
+        help="Accepted for reference-CLI compatibility (the sync-PS "
+        "accumulation knob).  Meaningless here: every step is already "
+        "bulk-synchronous over the mesh — gradients from all data "
+        "shards reduce inside the compiled step.",
+    )
     parser.add_argument("--training_data", default="")
     parser.add_argument("--validation_data", default="")
     parser.add_argument("--prediction_data", default="")

@@ -1,22 +1,21 @@
-"""Collective operations above what XLA emits automatically.
+"""Cross-host collective helpers above what XLA emits automatically.
 
-Parity: reference python/collective_ops/ + Horovod wrapper (SURVEY.md C15).
-On TPU, device-level collectives are XLA's job: inside `jit` they are
-emitted from shardings, and inside `shard_map` code uses the `jax.lax`
-primitives directly.  What remains for a framework module is the
-cross-HOST layer (process-level gathers for host-side metrics/output) and
-the named patterns the reference's Horovod wrapper provided (gradient
-allreduce, broadcast-on-init).  There is deliberately no hand-rolled ring
-— XLA owns scheduling and fusion.
+Parity: reference python/collective_ops/ + Horovod wrapper (SURVEY.md
+C15).  On TPU, device-level collectives are XLA's job: inside `jit` they
+are emitted from shardings (the gradient all-reduce, the embedding
+id-routing), and algorithmic `shard_map` code (ring attention, the GPipe
+schedule) uses the `jax.lax` primitives directly.  What remains for a
+framework module is the cross-HOST layer: process-level gathers for
+host-side code.  There is deliberately no hand-rolled ring — XLA owns
+scheduling and fusion — and no wrapper aliases around `jax.lax`
+(earlier rounds carried broadcast/pmean helpers with no production
+callers; they were deleted rather than kept as vocabulary).
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-
-from elasticdl_tpu.parallel.mesh import DATA_AXIS
 
 
 def host_allgather(x) -> np.ndarray:
@@ -29,21 +28,3 @@ def host_allgather(x) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return multihost_utils.process_allgather(x, tiled=True)
-
-
-def allreduce_mean_gradients(grads, axis_name: str = DATA_AXIS):
-    """Explicit DP gradient averaging for shard_map-style training loops.
-    (The jit/NamedSharding path does not need this — the partitioner
-    inserts the reduction.)"""
-    return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), grads)
-
-
-def broadcast_from(value, root: int = 0, axis_name: str = DATA_AXIS):
-    """Broadcast `value` from shard `root` to all shards of `axis_name`
-    (the Horovod broadcast-variables-on-init equivalent, used after an
-    elastic re-init when a replacement worker must adopt rank 0's state)."""
-    idx = jax.lax.axis_index(axis_name)
-    masked = jax.tree.map(
-        lambda v: jnp.where(idx == root, v, jnp.zeros_like(v)), value
-    )
-    return jax.tree.map(lambda v: jax.lax.psum(v, axis_name), masked)

@@ -368,7 +368,19 @@ class Trainer:
                 return out.step, anchor
 
             fused = cache[iters] = jax.jit(multi)
-        jax.device_get(fused(state, batch))  # compile + warm
+        # warm once per (iters, shapes): compile + first-exec costs; later
+        # repeats (bench medians) skip it — re-warming every repeat would
+        # double the device work under a wall-clock-budgeted driver
+        warmed = getattr(self, "_fused_timing_warmed", None)
+        if warmed is None:
+            warmed = self._fused_timing_warmed = set()
+        key = (iters, tuple(
+            (tuple(x.shape), str(x.dtype))
+            for x in jax.tree.leaves(batch)
+        ))
+        if key not in warmed:
+            jax.device_get(fused(state, batch))
+            warmed.add(key)
         start = time.perf_counter()
         jax.device_get(fused(state, batch))
         return iters / (time.perf_counter() - start)
