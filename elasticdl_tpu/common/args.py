@@ -84,6 +84,15 @@ def add_common_params(parser: argparse.ArgumentParser):
         "equivalent)",
     )
     parser.add_argument(
+        "--workers_per_group", type=pos_int, default=1,
+        help="Slice-granular failure handling (TPU: one preempted host "
+        "stalls the whole slice's ICI collectives).  Workers are "
+        "partitioned into groups of this size; when one member truly "
+        "fails, the surviving members are proactively restarted "
+        "(budget-free) instead of each waiting out its wedge-watchdog "
+        "grace.  1 = per-worker granularity (the reference's model).",
+    )
+    parser.add_argument(
         "--wedge_grace_s", type=float, default=20.0,
         help="Seconds a rank may lag a membership-epoch change before its "
         "watchdog assumes it is wedged in a collective with a dead peer "

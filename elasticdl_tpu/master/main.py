@@ -167,6 +167,7 @@ class Master:
                 on_job_abort=self._on_job_abort,
                 recovery_clock=self.recovery_clock,
                 volumes=parse_volumes(getattr(args, "volume", "")),
+                workers_per_group=getattr(args, "workers_per_group", 1),
             )
         self.servicer = MasterServicer(
             self.task_manager,

@@ -36,6 +36,7 @@ class PodManager:
         on_job_abort=None,
         recovery_clock=None,
         volumes: Optional[List[Dict[str, str]]] = None,
+        workers_per_group: int = 1,
     ):
         self._k8s = k8s_client
         self._tm = task_manager
@@ -48,6 +49,20 @@ class PodManager:
         self._resources = worker_resources or {}
         self._priority_class = priority_class
         self._volumes = volumes or []
+        # Slice-granular failure handling (SURVEY.md hard part 3): on TPU
+        # one preempted HOST stalls the whole slice's ICI collectives, so
+        # the schedulable/restartable unit is the group of
+        # `workers_per_group` workers sharing a slice.  When one member
+        # truly fails, the surviving members are proactively restarted
+        # (they are wedged in dead collectives anyway) instead of each
+        # waiting out its own wedge-watchdog grace.  1 = per-worker
+        # granularity (the reference's model).
+        self._workers_per_group = max(1, workers_per_group)
+        self._group_of: Dict[int, int] = {}
+        self._next_slot = 0
+        # pod names we deleted as part of a group restart: their DELETED
+        # events relaunch WITHOUT charging the chain budget
+        self._group_restart_pods: set = set()
         # Fired when the last worker dies with its relaunch chain exhausted
         # — without it a fully-crashed job would hang the master forever.
         self._on_job_abort = on_job_abort or (lambda reason: None)
@@ -108,6 +123,15 @@ class PodManager:
                         failed_history,
                     )
                 adopted += 1
+            # Rebuild slice groups for adopted workers by packing them in
+            # sorted-id order — an APPROXIMATION (pre-failover replacement
+            # workers may be regrouped differently than their true slice),
+            # but the failure mode is only a spurious budget-free peer
+            # restart; leaving groups empty would silently disable
+            # slice-granular recovery after every master failover.
+            for slot, wid in enumerate(sorted(self._pod_by_worker)):
+                self._group_of[wid] = slot // self._workers_per_group
+            self._next_slot = len(self._pod_by_worker)
             if self._rendezvous is not None and adopted:
                 self._rendezvous.set_expected(len(self._pod_by_worker))
         if adopted:
@@ -138,11 +162,18 @@ class PodManager:
         for pod in pods:
             self._k8s.delete_pod(pod)
 
-    def _launch_worker(self, worker_id: Optional[int] = None) -> int:
+    def _launch_worker(
+        self, worker_id: Optional[int] = None,
+        group: Optional[int] = None,
+    ) -> int:
         with self._lock:
             if worker_id is None:
                 worker_id = self._next_worker_id
                 self._next_worker_id += 1
+            if group is None:
+                group = self._next_slot // self._workers_per_group
+                self._next_slot += 1
+            self._group_of[worker_id] = group
             pod_name = self._register_worker_locked(worker_id)
         spec = PodSpec(
             name=pod_name,
@@ -200,6 +231,7 @@ class PodManager:
             with self._lock:
                 self._pod_by_worker.pop(worker_id, None)
                 self._worker_by_pod.pop(pod_name, None)
+                self._group_of.pop(worker_id, None)
                 if self._rendezvous is not None:
                     self._rendezvous.set_expected(len(self._pod_by_worker))
 
@@ -214,6 +246,9 @@ class PodManager:
         if self._rendezvous is not None:
             self._rendezvous.remove_worker(worker_id)
         with self._lock:
+            group_restart = pod_name in self._group_restart_pods
+            self._group_restart_pods.discard(pod_name)
+            group = self._group_of.pop(worker_id, None)
             self._pod_by_worker.pop(worker_id, None)
             self._worker_by_pod.pop(pod_name, None)
             if self._rendezvous is not None:
@@ -221,16 +256,22 @@ class PodManager:
                 # chain is exhausted this IS the new target, so waiting
                 # workers don't deadlock on a world size that cannot come.
                 self._rendezvous.set_expected(len(self._pod_by_worker))
-        # 3. relaunch within budget (FAILED only: DELETED = intentional).
+        # 3. relaunch within budget.  DELETED = intentional (scale-down)
+        # and is not relaunched — EXCEPT deletes this manager issued
+        # itself as part of a group restart, which relaunch budget-free.
         # The budget is tracked per replacement CHAIN: a replacement pod
         # inherits the failure count of the worker it replaces, so a
         # crash-looping worker fails the chain after `budget` relaunches
         # instead of looping forever under fresh ids.  Id allocation and
         # chain-count update happen in ONE critical section so two
         # near-simultaneous failures cannot under-count the chain.
-        if self.stopped or phase == PodStatus.DELETED:
+        if self.stopped or (
+            phase == PodStatus.DELETED and not group_restart
+        ):
             return
-        intentional = exit_code in self.INTENTIONAL_RESTART_CODES
+        intentional = group_restart or (
+            exit_code in self.INTENTIONAL_RESTART_CODES
+        )
         with self._lock:
             count = self._relaunch_count.get(worker_id, 0)
             if not intentional and count >= self._relaunch_budget:
@@ -243,20 +284,56 @@ class PodManager:
             else:
                 # New worker id (reference: replacements get fresh ids);
                 # id allocation + chain count in one critical section.
-                # Intentional self-restarts (watchdog / topology change)
-                # inherit the chain count unchanged.
+                # Intentional self-restarts (watchdog / topology change /
+                # group restarts) inherit the chain count unchanged.
                 new_id = self._next_worker_id
                 self._next_worker_id += 1
                 self._relaunch_count[new_id] = (
                     count if intentional else count + 1
                 )
         if new_id is not None:
-            self._launch_worker(new_id)
+            # peers first: sweeping after the launch would catch the
+            # fresh replacement in its own group's restart
+            if not intentional:
+                self._restart_group_peers(group, lost_worker=worker_id)
+            # the replacement joins the lost worker's slice group
+            self._launch_worker(new_id, group=group)
         elif none_alive:
             self._on_job_abort(
                 f"all workers dead; worker {worker_id} exhausted its "
                 f"relaunch budget ({self._relaunch_budget})"
             )
+
+    def _restart_group_peers(self, group: Optional[int],
+                             lost_worker: int) -> None:
+        """Slice-granular recovery: a real failure of one group member
+        means its peers are wedged in dead ICI collectives.  Delete their
+        pods now (marked, so the DELETED events relaunch budget-free)
+        instead of letting each wait out its own wedge-watchdog grace —
+        the group re-forms in one rendezvous epoch."""
+        if self._workers_per_group <= 1 or group is None:
+            return
+        with self._lock:
+            peers = [
+                (w, self._pod_by_worker[w])
+                for w, g in self._group_of.items()
+                if g == group and w != lost_worker
+                and w in self._pod_by_worker
+            ]
+            for _, pod in peers:
+                self._group_restart_pods.add(pod)
+        for w, pod in peers:
+            logger.info(
+                "Group %d restart: deleting peer worker %d (%s) of "
+                "failed worker %d", group, w, pod, lost_worker,
+            )
+            try:
+                self._k8s.delete_pod(pod)
+            except Exception:
+                # peer already gone (its own watchdog beat us) — its
+                # FAILED event relaunches via the intentional-exit path
+                with self._lock:
+                    self._group_restart_pods.discard(pod)
 
     # ---- introspection -------------------------------------------------
 

@@ -282,3 +282,86 @@ def test_intentional_restart_codes_do_not_burn_budget():
     (worker_id,) = manager.alive_workers()
     k8s.emit(f"budget-worker-{worker_id}", "Failed", exit_code=1)
     assert not manager.alive_workers()
+
+
+def test_group_restart_on_member_failure():
+    """Slice-granular recovery (SURVEY hard part 3): with
+    workers_per_group=2, a REAL failure of one member proactively
+    restarts its peer (budget-free), so the slice re-forms in one epoch
+    instead of the peer waiting out its wedge grace."""
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.master.pod_manager import PodManager
+
+    k8s = FakeK8sClient()
+    manager = PodManager(
+        k8s, job_name="slice", num_workers=4,
+        relaunch_on_worker_failure=2, workers_per_group=2,
+    )
+    manager.start()
+    assert manager.alive_workers() == [0, 1, 2, 3]
+    # groups assigned by launch slot: {0: [0,1], 1: [2,3]}
+    assert manager._group_of == {0: 0, 1: 0, 2: 1, 3: 1}
+
+    # worker 2 (group 1) crashes for real
+    k8s.emit("slice-worker-2", "Failed", exit_code=1)
+    alive = manager.alive_workers()
+    # group 0 untouched; group 1 fully replaced (peer 3's pod deleted)
+    assert 0 in alive and 1 in alive
+    assert 2 not in alive and 3 not in alive
+    assert len(alive) == 4
+    assert "slice-worker-3" in k8s.delete_calls
+    # both replacements are back in group 1
+    new = [w for w in alive if w >= 4]
+    assert all(manager._group_of[w] == 1 for w in new)
+    # the peer's restart was budget-free: its chain count did not grow
+    # beyond the failed member's charge
+    for w in new:
+        assert manager._relaunch_count.get(w, 0) <= 1
+
+    # a scale-down delete must NOT trigger group restarts
+    before = set(manager.alive_workers())
+    manager.scale_down(1)
+    after = set(manager.alive_workers())
+    assert len(before - after) == 1, "scale_down removed exactly one"
+
+
+def test_group_size_one_is_per_worker_granularity():
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.master.pod_manager import PodManager
+
+    k8s = FakeK8sClient()
+    manager = PodManager(
+        k8s, job_name="solo", num_workers=2,
+        relaunch_on_worker_failure=2, workers_per_group=1,
+    )
+    manager.start()
+    k8s.emit("solo-worker-0", "Failed", exit_code=1)
+    alive = manager.alive_workers()
+    # only the failed worker was replaced; worker 1 untouched
+    assert 1 in alive and len(alive) == 2
+    assert "solo-worker-1" not in k8s.delete_calls
+
+
+def test_adopted_workers_regain_groups():
+    """A replacement master packs adopted workers into slice groups
+    (sorted-id approximation), so slice-granular recovery survives
+    master failover instead of silently degrading to per-worker mode."""
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.master.pod_manager import PodManager
+
+    k8s = FakeK8sClient()
+    first = PodManager(
+        k8s, job_name="adopt", num_workers=4, workers_per_group=2,
+    )
+    first.start()
+    # "new" master process adopts the same live cluster
+    second = PodManager(
+        k8s, job_name="adopt", num_workers=4, workers_per_group=2,
+    )
+    second._k8s._callback = None  # detach first manager's watch
+    second.start()
+    assert second._group_of == {0: 0, 1: 0, 2: 1, 3: 1}
+    # a real member failure still group-restarts under the new master
+    k8s.emit("adopt-worker-2", "Failed", exit_code=1)
+    assert "adopt-worker-3" in k8s.delete_calls
+    assert len(second.alive_workers()) == 4
