@@ -123,6 +123,13 @@ class AbstractK8sClient:
         tolerance)."""
         return []
 
+    def get_pod_labels(self, name: str) -> Dict[str, str]:
+        """Labels stamped on the pod at creation (k8s metadata).  Used by
+        a replacement master to recover exact slice-group identity during
+        adoption; clients without label storage may return {} (the pod
+        manager falls back to packed groups)."""
+        return {}
+
     def master_host(self, job_name: str) -> str:
         """Hostname worker pods use to reach the master.  Real clusters
         resolve the master Service's DNS name; process-backed local
@@ -177,6 +184,11 @@ class FakeK8sClient(AbstractK8sClient):
     def get_pod_phase(self, name: str) -> str:
         with self._lock:
             return self.phases.get(name, PodStatus.UNKNOWN)
+
+    def get_pod_labels(self, name: str):
+        with self._lock:
+            spec = self.pods.get(name)
+            return dict(spec.labels) if spec is not None else {}
 
     def list_pods(self):
         with self._lock:
@@ -297,6 +309,11 @@ class ProcessK8sClient(AbstractK8sClient):
     def get_pod_phase(self, name: str) -> str:
         with self._lock:
             return self.phases.get(name, PodStatus.UNKNOWN)
+
+    def get_pod_labels(self, name: str):
+        with self._lock:
+            spec = self.pods.get(name)
+            return dict(spec.labels) if spec is not None else {}
 
     def list_pods(self):
         with self._lock:
@@ -461,6 +478,16 @@ class K8sClient(AbstractK8sClient):
         pod = self._core.read_namespaced_pod(name, self._namespace)
         return pod.status.phase
 
+    def get_pod_labels(self, name: str):
+        # served from the last list_pods response when possible: adoption
+        # calls list_pods first, then labels per pod — without the cache
+        # that is N+1 sequential apiserver round-trips per failover
+        cached = getattr(self, "_labels_cache", {}).get(name)
+        if cached is not None:
+            return dict(cached)
+        pod = self._core.read_namespaced_pod(name, self._namespace)
+        return dict(pod.metadata.labels or {})
+
     def list_pods(self):
         pods = self._core.list_namespaced_pod(
             self._namespace,
@@ -469,6 +496,7 @@ class K8sClient(AbstractK8sClient):
             ),
         )
         out = []
+        self._labels_cache = {}
         for pod in pods.items:
             try:
                 worker_id = int(
@@ -476,6 +504,9 @@ class K8sClient(AbstractK8sClient):
                 )
             except (TypeError, ValueError):
                 worker_id = -1
+            self._labels_cache[pod.metadata.name] = dict(
+                pod.metadata.labels or {}
+            )
             out.append(
                 (
                     pod.metadata.name,

@@ -123,22 +123,60 @@ class PodManager:
                         failed_history,
                     )
                 adopted += 1
-            # Rebuild slice groups for adopted workers by packing them in
-            # sorted-id order — an APPROXIMATION (pre-failover replacement
-            # workers may be regrouped differently than their true slice),
-            # but the failure mode is only a spurious budget-free peer
-            # restart; leaving groups empty would silently disable
-            # slice-granular recovery after every master failover.
-            for slot, wid in enumerate(sorted(self._pod_by_worker)):
-                self._group_of[wid] = slot // self._workers_per_group
-            self._next_slot = len(self._pod_by_worker)
+            # Rebuild slice groups for adopted workers from the
+            # `elasticdl-group` pod label each launch stamps (exact
+            # identity across master failover); pods without the label —
+            # older jobs, clients without label storage — fall back to
+            # packing in sorted-id order, whose worst case is a spurious
+            # budget-free peer restart.
+            unlabeled = []
+            for wid in sorted(self._pod_by_worker):
+                labels = {}
+                try:
+                    labels = self._k8s.get_pod_labels(
+                        self._pod_by_worker[wid]
+                    )
+                except Exception as exc:
+                    # demoted to packed grouping below — log it, or the
+                    # resulting mis-grouped restart is undebuggable
+                    logger.warning(
+                        "Label lookup failed for adopted pod %s (%s); "
+                        "falling back to packed group assignment",
+                        self._pod_by_worker[wid], exc,
+                    )
+                tag = str(labels.get("elasticdl-group", ""))
+                if tag.isdigit():
+                    self._group_of[wid] = int(tag)
+                else:
+                    unlabeled.append(wid)
+            base = max(self._group_of.values(), default=-1) + 1
+            for i, wid in enumerate(unlabeled):
+                self._group_of[wid] = base + i // self._workers_per_group
+            self._next_slot = (
+                max(self._group_of.values(), default=-1) + 1
+            ) * self._workers_per_group
             if self._rendezvous is not None and adopted:
                 self._rendezvous.set_expected(len(self._pod_by_worker))
         if adopted:
             logger.info("Adopted %d live worker pods", adopted)
         self._k8s.start_watch(self._event_cb)
+        # Make-up launches fill VACANCIES in partially-occupied adopted
+        # groups first (a worker that died alongside its master must
+        # rejoin its slice, not open a singleton group); only then do new
+        # slots open new groups.
+        with self._lock:
+            occupancy: Dict[int, int] = {}
+            for g in self._group_of.values():
+                occupancy[g] = occupancy.get(g, 0) + 1
+            vacancies = [
+                g
+                for g, count in sorted(occupancy.items())
+                for _ in range(self._workers_per_group - count)
+                if count < self._workers_per_group
+            ]
         for _ in range(max(0, self._num_workers - adopted)):
-            self._launch_worker()
+            group = vacancies.pop(0) if vacancies else None
+            self._launch_worker(group=group)
 
     def stop(self):
         self.stopped = True
@@ -184,6 +222,10 @@ class PodManager:
             resources=self._resources,
             priority_class=self._priority_class,
             volumes=self._volumes,
+            # durable slice-group identity: a replacement master reads it
+            # back during adoption (get_pod_labels), so group restarts
+            # survive failover exactly, not by approximation
+            labels={"elasticdl-group": str(group)},
         )
         logger.info("Launching %s", pod_name)
         self._k8s.create_pod(spec)

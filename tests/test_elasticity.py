@@ -342,26 +342,70 @@ def test_group_size_one_is_per_worker_granularity():
     assert "solo-worker-1" not in k8s.delete_calls
 
 
-def test_adopted_workers_regain_groups():
-    """A replacement master packs adopted workers into slice groups
-    (sorted-id approximation), so slice-granular recovery survives
-    master failover instead of silently degrading to per-worker mode."""
+def test_adopted_workers_regain_exact_groups_from_labels():
+    """Slice-group identity is stamped on each pod as the
+    `elasticdl-group` label, so a replacement master recovers EXACT
+    groups during adoption — including for pre-failover replacement
+    workers, whose ids are no longer slot-ordered (sorted-id packing
+    would mis-group them)."""
     from elasticdl_tpu.common.k8s_client import FakeK8sClient
     from elasticdl_tpu.master.pod_manager import PodManager
 
     k8s = FakeK8sClient()
     first = PodManager(
-        k8s, job_name="adopt", num_workers=4, workers_per_group=2,
+        k8s, job_name="adopt", num_workers=4,
+        relaunch_on_worker_failure=3, workers_per_group=2,
     )
     first.start()
+    # crash worker 1 (group 0): its group peers restart too; the live set
+    # becomes {2, 3} (group 1) + two fresh ids in group 0 — id order no
+    # longer matches group order
+    k8s.emit("adopt-worker-1", "Failed", exit_code=1)
+    true_groups = dict(first._group_of)
+    assert sorted(true_groups.values()).count(0) == 2
+    assert any(w >= 4 for w in true_groups), true_groups
+
     # "new" master process adopts the same live cluster
     second = PodManager(
-        k8s, job_name="adopt", num_workers=4, workers_per_group=2,
+        k8s, job_name="adopt", num_workers=4,
+        relaunch_on_worker_failure=3, workers_per_group=2,
     )
     second._k8s._callback = None  # detach first manager's watch
     second.start()
-    assert second._group_of == {0: 0, 1: 0, 2: 1, 3: 1}
+    assert second._group_of == true_groups
     # a real member failure still group-restarts under the new master
-    k8s.emit("adopt-worker-2", "Failed", exit_code=1)
-    assert "adopt-worker-3" in k8s.delete_calls
+    victim = min(w for w, g in true_groups.items() if g == 1)
+    peer = max(w for w, g in true_groups.items() if g == 1)
+    k8s.emit(f"adopt-worker-{victim}", "Failed", exit_code=1)
+    assert f"adopt-worker-{peer}" in k8s.delete_calls
     assert len(second.alive_workers()) == 4
+
+
+def test_failover_makeup_launch_fills_group_vacancy():
+    """A worker that died alongside its master must rejoin its slice
+    group on the replacement master's make-up launch — not open a
+    singleton group (which would silently disable peer restarts for the
+    real slice-mates)."""
+    from elasticdl_tpu.common.constants import PodStatus
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.master.pod_manager import PodManager
+
+    k8s = FakeK8sClient()
+    first = PodManager(
+        k8s, job_name="vac", num_workers=4, workers_per_group=2,
+    )
+    first.start()
+    # worker 1 (group 0) dies and the master dies before reacting: mark
+    # the pod Failed directly with no first-manager callback attached
+    k8s._callback = None
+    with k8s._lock:
+        k8s.phases["vac-worker-1"] = PodStatus.FAILED
+
+    second = PodManager(
+        k8s, job_name="vac", num_workers=4, workers_per_group=2,
+    )
+    second.start()
+    assert len(second.alive_workers()) == 4
+    # the make-up worker filled group 0's vacancy
+    groups = sorted(second._group_of.values())
+    assert groups == [0, 0, 1, 1], second._group_of
