@@ -11,6 +11,10 @@ model definitions port by re-implementing bodies in Flax/Optax:
     eval_metrics_fn()         -> {name: fn(labels, predictions) -> scalar}
     custom_data_reader(**kw)  -> AbstractDataReader (optional)
     callbacks()               -> list (optional)
+    feed_bulk(buffer, sizes, metadata) -> batch dict (optional; vectorized
+                                 parse of a contiguous uint8 payload
+                                 buffer + int64 per-record sizes — the
+                                 fast path for fixed-width records)
     param_sharding(path,leaf) -> PartitionSpec | None (optional; TPU-native
                                  extension for sharded embeddings / TP)
 
@@ -41,6 +45,7 @@ class ModelSpec:
     loss: Callable
     optimizer: Any
     feed: Callable
+    feed_bulk: Optional[Callable] = None
     eval_metrics: Dict[str, Callable] = field(default_factory=dict)
     custom_data_reader: Optional[Callable] = None
     callbacks: list = field(default_factory=list)
@@ -127,6 +132,7 @@ def get_model_spec(
         loss=opt(loss),
         optimizer=_call_with_params(opt(optimizer), model_params),
         feed=opt(dataset_fn),
+        feed_bulk=opt("feed_bulk", required=False),
         eval_metrics=metrics_factory() if metrics_factory else {},
         custom_data_reader=reader_factory,
         callbacks=callbacks_factory() if callbacks_factory else [],

@@ -22,6 +22,14 @@ class AbstractDataReader(abc.ABC):
     def read_records(self, task) -> Iterator:
         """Yield records for task.shard ([start, end) of shard.name)."""
 
+    def read_records_bulk(self, task):
+        """Optional bulk path: return (uint8 payload buffer, int64 sizes)
+        numpy arrays for the task's records, or None when this reader has
+        no bulk representation (callers then fall back to the streaming
+        `read_records`).  Pairs with the zoo's optional `feed_bulk` hook
+        for vectorized record parsing."""
+        return None
+
     @abc.abstractmethod
     def create_shards(self) -> List[Tuple[str, int, int]]:
         """Enumerate (source_name, start, end) ranges covering the data."""

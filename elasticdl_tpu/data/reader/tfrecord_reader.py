@@ -44,5 +44,9 @@ class TFRecordDataReader(AbstractDataReader):
         reader = self._reader(task.shard.name)
         yield from reader.read(task.shard.start, task.shard.end)
 
+    def read_records_bulk(self, task):
+        reader = self._reader(task.shard.name)
+        return reader.read_bulk(task.shard.start, task.shard.end)
+
     def create_shards(self) -> List[Tuple[str, int, int]]:
         return [(f, 0, len(self._reader(f))) for f in self._files()]

@@ -86,6 +86,13 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def stacked_data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a (K, B, ...) stack of K batches (steps_per_execution
+    dispatch): the scan axis stays whole, the batch axis splits over
+    `data`."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

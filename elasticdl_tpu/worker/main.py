@@ -155,6 +155,14 @@ def main(argv=None):
             worker_id, cluster.rendezvous_id, me.rank, cluster.world_size,
             my_addr, cluster.coordinator_address,
         )
+        if getattr(args, "steps_per_execution", 1) > 1:
+            # The SPMD collective step is dispatched per global batch;
+            # stack dispatch there needs global-array stacking, not yet
+            # wired.  Warn rather than silently ignore the flag.
+            logger.warning(
+                "--steps_per_execution > 1 applies to Local/single-"
+                "worker mode only; cluster SPMD ignores it"
+            )
         worker = SPMDWorker(
             worker_id=worker_id,
             master_client=client,
@@ -187,6 +195,7 @@ def main(argv=None):
             use_bf16=args.use_bf16,
             checkpoint_saver=saver_factory() if saver_factory else None,
             checkpoint_steps=args.checkpoint_steps,
+            steps_per_execution=getattr(args, "steps_per_execution", 1),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")

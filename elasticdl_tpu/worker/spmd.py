@@ -427,7 +427,8 @@ class SPMDWorker:
     def _train_task_inner(self, task: pb.Task) -> int:
         records = 0
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
             self._ensure_state(batch)
             global_batch = mesh_lib.make_global_batch(batch, self.mesh)
@@ -466,7 +467,8 @@ class SPMDWorker:
         all_labels, all_preds = [], []
         eval_state, actual_version = None, None
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
             self._ensure_state(batch)
             if actual_version is None:
@@ -507,7 +509,8 @@ class SPMDWorker:
         rows = []
         processor = self.spec.prediction_outputs_processor
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
             self._ensure_state(batch)
             features = mesh_lib.make_global_batch(
@@ -713,6 +716,14 @@ class SPMDWorker:
 
     def _feed(self, records):
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))
+
+    @property
+    def _feed_bulk(self):
+        """Vectorized-parse closure (same contract as Worker._feed_bulk)."""
+        if self.spec.feed_bulk is None:
+            return None
+        metadata = getattr(self._reader, "metadata", {})
+        return lambda buf, sizes: self.spec.feed_bulk(buf, sizes, metadata)
 
 
 from elasticdl_tpu.parallel.collectives import (  # noqa: E402

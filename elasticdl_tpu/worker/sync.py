@@ -99,6 +99,17 @@ class ModelOwner:
             self._maybe_checkpoint()
             return loss
 
+    def train_batch_stack(self, batches):
+        """steps_per_execution path: len(batches) steps in one dispatch
+        (Trainer.train_on_batch_stack); returns the per-step losses."""
+        with self.lock:
+            self.ensure_state(batches[0])
+            self.state, losses = self.trainer.train_on_batch_stack(
+                self.state, batches
+            )
+            self._maybe_checkpoint(stride=len(batches))
+            return losses
+
     def predict_batch(self, batch, state=None):
         """Forward pass; `state` overrides the owner's current state (eval
         at a restored version)."""
@@ -118,12 +129,17 @@ class ModelOwner:
         if self.checkpoint_saver is not None:
             self.checkpoint_saver.wait_until_finished()
 
-    def _maybe_checkpoint(self) -> None:
+    def _maybe_checkpoint(self, stride: int = 1) -> None:
+        """Checkpoint when [step-stride, step] crossed a multiple of
+        checkpoint_steps.  `stride` is the number of steps the last
+        dispatch advanced (steps_per_execution): an exact-modulo check
+        would skip every multiple the K-step jump lands past, stretching
+        the cadence to lcm(K, checkpoint_steps)."""
         if (
             self.checkpoint_saver is not None
             and self.checkpoint_steps
             and self.state is not None
-            and int(self.state.step) % self.checkpoint_steps == 0
+            and int(self.state.step) % self.checkpoint_steps < stride
         ):
             self.checkpoint_saver.save(self.state)
 

@@ -288,9 +288,20 @@ class Trainer:
             )
             return preds.astype(jnp.float32)
 
+        def train_step_many(state: TrainState, stacked):
+            # K serially-dependent train steps in ONE dispatched program
+            # (lax.scan over a (K, B, ...) batch stack).  This is
+            # `steps_per_execution`: per-dispatch overhead — significant
+            # on remote/tunneled TPU runtimes (measured ~0.8s/call on the
+            # axon tunnel vs ~0.2s device work) — is paid once per K
+            # steps, and XLA overlaps the scan's iterations' transfers
+            # and compute.
+            return jax.lax.scan(train_step, state, stacked)
+
         # Shardings: batch split on `data`; XLA inserts the gradient
         # all-reduce from the sharding propagation (no explicit psum).
         self.train_step = jax.jit(train_step, donate_argnums=(0,))
+        self.train_step_many = jax.jit(train_step_many, donate_argnums=(0,))
         self.eval_step = jax.jit(eval_step)
 
     # ---- host-side helpers --------------------------------------------
@@ -300,6 +311,21 @@ class Trainer:
         batch = mesh_lib.shard_batch(batch, self.mesh)
         state, loss = run_device_serialized(self.train_step, state, batch)
         return state, loss
+
+    def train_on_batch_stack(self, state, batches):
+        """One dispatch covering len(batches) train steps (jitted
+        lax.scan).  Returns (state, losses) with losses shaped (K,).
+        Batches must share shapes (the data service's static-shape
+        contract guarantees it)."""
+        mesh_lib.set_current_mesh(self.mesh)
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+        sharding = mesh_lib.stacked_data_sharding(self.mesh)
+        stacked = jax.tree.map(
+            lambda x: jax.device_put(x, sharding), stacked
+        )
+        return run_device_serialized(
+            self.train_step_many, state, stacked
+        )
 
     def train_on_global_batch(self, state, global_batch):
         """Train step on a batch already assembled into global arrays

@@ -68,10 +68,15 @@ class Worker:
         model_owner: Optional[ModelOwner] = None,
         tensorboard_dir: str = "",
         profile_dir: str = "",
+        steps_per_execution: int = 1,
     ):
         self.worker_id = worker_id
         self.spec = spec
         self.minibatch_size = minibatch_size
+        # >1 dispatches that many train steps as ONE jitted lax.scan
+        # program (Trainer.train_on_batch_stack) — amortizes per-dispatch
+        # overhead, which dominates on remote/tunneled TPU runtimes.
+        self.steps_per_execution = max(1, int(steps_per_execution))
         self._client = master_client
         self._data_service = TaskDataService(
             master_client, data_reader, worker_id
@@ -240,12 +245,34 @@ class Worker:
     def _train_task_inner(self, task: pb.Task) -> int:
         records = 0
         loss = None
+        pending = []
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
+            records += real
+            if self.steps_per_execution > 1:
+                # full groups dispatch as one scan program; the task's
+                # tail (< steps_per_execution batches) falls through to
+                # the single-step program below, so only the two K values
+                # {1, steps_per_execution} are ever compiled
+                pending.append(batch)
+                if len(pending) == self.steps_per_execution:
+                    losses = self._owner.train_batch_stack(pending)
+                    for _ in pending:
+                        self.step_timer.tick()
+                    pending.clear()
+                    loss = losses[-1]
+                    # per-step history, as documented: the scan returns
+                    # all K losses (one device array; indexing is lazy)
+                    self.losses.extend(losses)
+                continue
             loss = self._owner.train_batch(batch)
             self.step_timer.tick()
-            records += real
+            self.losses.append(loss)
+        for batch in pending:
+            loss = self._owner.train_batch(batch)
+            self.step_timer.tick()
             self.losses.append(loss)
         if loss is not None:
             # One scalar write per TASK, not per step: forcing the loss to
@@ -275,7 +302,8 @@ class Worker:
         all_labels, all_preds = [], []
         eval_state, actual_version = None, None
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
             if actual_version is None:
                 # Eval-at-version (§3.5): score the checkpointed state at
@@ -319,7 +347,8 @@ class Worker:
         processor = self.spec.prediction_outputs_processor
         rows = []
         for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed
+            task, self.minibatch_size, self._feed,
+            feed_bulk=self._feed_bulk,
         ):
             preds = self._owner.predict_batch(batch)
             rows.append(preds[:real])
@@ -347,6 +376,15 @@ class Worker:
 
     def _feed(self, records):
         return self.spec.feed(records, getattr(self._reader, "metadata", {}))
+
+    @property
+    def _feed_bulk(self):
+        """Vectorized-parse closure for batches_for_task, or None when the
+        zoo module has no feed_bulk (the streaming feed path then runs)."""
+        if self.spec.feed_bulk is None:
+            return None
+        metadata = getattr(self._reader, "metadata", {})
+        return lambda buf, sizes: self.spec.feed_bulk(buf, sizes, metadata)
 
 
 def _task_export_config(task: pb.Task) -> dict:

@@ -226,6 +226,25 @@ def feed(records, metadata=None, max_len: int = MAX_LEN):
     return {"features": {"input_ids": ids}, "labels": labels}
 
 
+def feed_bulk(buffer, sizes, metadata=None):
+    """Vectorized parse of the fixed-width record (max_len int32 ids + 1
+    label byte); max_len is derived from the record size, so one parser
+    serves every dataset length."""
+    sizes = np.asarray(sizes)
+    n = len(sizes)
+    if n == 0 or not (sizes == sizes[0]).all() or sizes[0] % 4 != 1:
+        raise ValueError(
+            "bert feed_bulk expects fixed-width 4*max_len+1 byte records"
+        )
+    rec = int(sizes[0])
+    arr = np.frombuffer(buffer, np.uint8).reshape(n, rec)
+    ids = np.ascontiguousarray(arr[:, : rec - 1]).view("<i4")
+    return {
+        "features": {"input_ids": ids},
+        "labels": arr[:, rec - 1].astype(np.int32),
+    }
+
+
 def eval_metrics_fn():
     return {
         "accuracy": lambda labels, predictions: float(

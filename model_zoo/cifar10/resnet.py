@@ -107,6 +107,22 @@ def feed(records, metadata=None):
     }
 
 
+def feed_bulk(buffer, sizes, metadata=None):
+    """Vectorized parse of the fixed 3073-byte record (3072 image bytes +
+    label byte)."""
+    n = len(sizes)
+    if n == 0 or not (np.asarray(sizes) == IMG_BYTES + 1).all():
+        raise ValueError(
+            f"cifar10 feed_bulk expects fixed {IMG_BYTES + 1}-byte records"
+        )
+    arr = np.frombuffer(buffer, np.uint8).reshape(n, IMG_BYTES + 1)
+    features = (arr[:, :IMG_BYTES].astype(np.float32) / 255.0 - 0.5)
+    return {
+        "features": features,
+        "labels": arr[:, IMG_BYTES].astype(np.int32),
+    }
+
+
 def eval_metrics_fn():
     return {
         "accuracy": lambda labels, predictions: float(

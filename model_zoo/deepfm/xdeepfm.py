@@ -32,6 +32,7 @@ from model_zoo.deepfm.deepfm_functional_api import (
     NUM_SPARSE,
     RECORD_BYTES,
     feed,
+    feed_bulk,
     field_offset_ids,
     loss,
     normalize_dense,
@@ -39,8 +40,9 @@ from model_zoo.deepfm.deepfm_functional_api import (
 )
 
 __all__ = [
-    "custom_model", "loss", "optimizer", "feed", "eval_metrics_fn",
-    "param_sharding", "RECORD_BYTES", "NUM_DENSE", "NUM_SPARSE",
+    "custom_model", "loss", "optimizer", "feed", "feed_bulk",
+    "eval_metrics_fn", "param_sharding", "RECORD_BYTES", "NUM_DENSE",
+    "NUM_SPARSE",
 ]
 
 

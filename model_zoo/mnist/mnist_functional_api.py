@@ -61,6 +61,19 @@ def feed(records, metadata=None):
     }
 
 
+def feed_bulk(buffer, sizes, metadata=None):
+    """Vectorized parse of the fixed 785-byte record (784 image bytes +
+    label byte): one reshape over the reader's contiguous buffer."""
+    n = len(sizes)
+    if n == 0 or not (np.asarray(sizes) == 785).all():
+        raise ValueError("mnist feed_bulk expects fixed 785-byte records")
+    arr = np.frombuffer(buffer, np.uint8).reshape(n, 785)
+    return {
+        "features": (arr[:, :784].astype(np.float32) / 255.0),
+        "labels": arr[:, 784].astype(np.int32),
+    }
+
+
 def eval_metrics_fn():
     return {
         "accuracy": lambda labels, predictions: float(

@@ -55,21 +55,31 @@ extern "C" {
 int64_t recordio_build_index(const char* path, int64_t** out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
+  std::vector<char> iobuf(1 << 20);
+  std::setvbuf(f, iobuf.data(), _IOFBF, iobuf.size());
   std::vector<int64_t> offsets;
   std::fseek(f, 0, SEEK_END);
   const int64_t size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
   int64_t pos = 0;
   uint8_t header[12];
+  // One sequential pass, skipping payloads with reads (not fseek, which
+  // discards the stdio buffer and costs a syscall per record).
+  std::vector<uint8_t> skip;
   while (pos < size) {
-    if (std::fseek(f, pos, SEEK_SET) != 0 ||
-        std::fread(header, 1, 12, f) != 12) {
+    if (std::fread(header, 1, 12, f) != 12) {
       std::fclose(f);
       return -2;
     }
     uint64_t length;
     std::memcpy(&length, header, 8);
     const int64_t next = pos + 8 + 4 + static_cast<int64_t>(length) + 4;
-    if (next > size) {
+    if (length > static_cast<uint64_t>(size) || next > size) {
+      std::fclose(f);
+      return -2;
+    }
+    if (skip.size() < length + 4) skip.resize(length + 4);
+    if (std::fread(skip.data(), 1, length + 4, f) != length + 4) {
       std::fclose(f);
       return -2;
     }
@@ -93,14 +103,28 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
                               uint8_t** out, int64_t** sizes_out) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
+  std::vector<char> iobuf(1 << 20);
+  std::setvbuf(f, iobuf.data(), _IOFBF, iobuf.size());
   std::fseek(f, 0, SEEK_END);
   const int64_t file_size = std::ftell(f);
   std::vector<uint8_t> buffer;
   std::vector<int64_t> sizes;
   uint8_t header[12];
+  // Seek only when the position actually moves: consecutive records (the
+  // overwhelmingly common case — task ranges) then stream through the
+  // stdio buffer with zero seeks.  A per-record fseek discards the
+  // buffer, costing one read syscall per record (measured 7.5s for a
+  // 512K-record range vs ~0.1s without).
+  int64_t pos = -1;
   for (int64_t i = start; i < end; ++i) {
-    if (std::fseek(f, offsets[i], SEEK_SET) != 0 ||
-        std::fread(header, 1, 12, f) != 12) {
+    if (pos != offsets[i]) {
+      if (std::fseek(f, offsets[i], SEEK_SET) != 0) {
+        std::fclose(f);
+        return -2;
+      }
+      pos = offsets[i];
+    }
+    if (std::fread(header, 1, 12, f) != 12) {
       std::fclose(f);
       return -2;
     }
@@ -132,6 +156,7 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
       std::fclose(f);
       return -2;
     }
+    pos += 12 + static_cast<int64_t>(length) + 4;
     if (check_crc) {
       uint32_t stored;
       std::memcpy(&stored, footer, 4);
@@ -155,6 +180,39 @@ int64_t recordio_read_records(const char* path, const int64_t* offsets,
   }
   std::memcpy(*sizes_out, sizes.data(), sizes.size() * sizeof(int64_t));
   return static_cast<int64_t>(buffer.size());
+}
+
+// Writes n records (concatenated payloads + per-record sizes) in TFRecord
+// framing, computing both CRCs natively — the Python table-driven crc32c
+// is per-byte and makes large dataset generation minutes-slow.  append=0
+// truncates, append!=0 appends.  Returns bytes written, negative on error.
+int64_t recordio_write_records(const char* path, const uint8_t* payloads,
+                               const int64_t* sizes, int64_t n,
+                               int append) {
+  FILE* f = std::fopen(path, append ? "ab" : "wb");
+  if (!f) return -1;
+  std::vector<char> iobuf(1 << 20);
+  std::setvbuf(f, iobuf.data(), _IOFBF, iobuf.size());
+  int64_t total = 0;
+  const uint8_t* p = payloads;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t length = static_cast<uint64_t>(sizes[i]);
+    uint8_t header[12];
+    std::memcpy(header, &length, 8);
+    const uint32_t hcrc = MaskedCrc(header, 8);
+    std::memcpy(header + 8, &hcrc, 4);
+    const uint32_t pcrc = MaskedCrc(p, length);
+    if (std::fwrite(header, 1, 12, f) != 12 ||
+        std::fwrite(p, 1, length, f) != length ||
+        std::fwrite(&pcrc, 1, 4, f) != 4) {
+      std::fclose(f);
+      return -2;
+    }
+    p += length;
+    total += 12 + static_cast<int64_t>(length) + 4;
+  }
+  if (std::fclose(f) != 0) return -2;
+  return total;
 }
 
 void recordio_free(void* ptr) { std::free(ptr); }

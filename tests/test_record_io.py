@@ -40,7 +40,9 @@ def test_index_cached_and_reused(tf_file):
     # corrupt the data file mtime-stable path: index should be trusted
     offsets = build_index(path)
     with TFRecordReader(path) as reader:
-        assert reader._offsets == offsets
+        import numpy as np
+
+        assert np.array_equal(reader._offsets, offsets)
 
 
 def test_tf_compat(tf_file):

@@ -1,0 +1,116 @@
+"""steps_per_execution: K train steps dispatched as one jitted lax.scan
+program (Trainer.train_on_batch_stack) must compute the same training
+trajectory as K sequential single-step dispatches."""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.worker.trainer import Trainer
+
+MODEL_ZOO = "model_zoo"
+
+
+def _batches(k=3, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        {
+            "features": rng.rand(batch, 784).astype(np.float32),
+            "labels": rng.randint(0, 10, batch).astype(np.int32),
+        }
+        for _ in range(k)
+    ]
+
+
+def test_stack_matches_sequential():
+    spec = get_model_spec(MODEL_ZOO, "mnist.mnist_functional_api.custom_model")
+    batches = _batches()
+
+    def make_trainer():
+        return Trainer(
+            model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
+        )
+
+    t1 = make_trainer()
+    state_seq = t1.init_state(jax.random.PRNGKey(0), batches[0]["features"])
+    seq_losses = []
+    for b in batches:
+        state_seq, loss = t1.train_on_batch(state_seq, b)
+        seq_losses.append(float(np.asarray(loss)))
+
+    t2 = make_trainer()
+    state_stk = t2.init_state(jax.random.PRNGKey(0), batches[0]["features"])
+    state_stk, losses = t2.train_on_batch_stack(state_stk, batches)
+
+    assert int(state_stk.step) == int(state_seq.step) == len(batches)
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(seq_losses), rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        state_stk.params, state_seq.params,
+    )
+
+
+def test_worker_tail_uses_single_step(monkeypatch):
+    """A worker at steps_per_execution=4 over 6 batches must dispatch one
+    stack of 4 and two singles (no recompile-per-tail-size)."""
+    from elasticdl_tpu.worker.sync import ModelOwner
+
+    spec = get_model_spec(MODEL_ZOO, "mnist.mnist_functional_api.custom_model")
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
+    )
+    owner = ModelOwner(trainer)
+    calls = {"stack": [], "single": 0}
+    orig_stack = owner.train_batch_stack
+    orig_single = owner.train_batch
+
+    def spy_stack(batches):
+        calls["stack"].append(len(batches))
+        return orig_stack(batches)
+
+    def spy_single(batch):
+        calls["single"] += 1
+        return orig_single(batch)
+
+    monkeypatch.setattr(owner, "train_batch_stack", spy_stack)
+    monkeypatch.setattr(owner, "train_batch", spy_single)
+
+    class OneTaskService:
+        def __init__(self, batches):
+            self._batches = batches
+
+        def batches_for_task(self, task, size, feed, feed_bulk=None):
+            for b in self._batches:
+                yield b, size
+
+    from elasticdl_tpu.proto import elasticdl_pb2 as pb
+    from elasticdl_tpu.worker.worker import Worker
+
+    worker = Worker.__new__(Worker)
+    worker.steps_per_execution = 4
+    worker._owner = owner
+    worker._data_service = OneTaskService(_batches(k=6))
+    worker.minibatch_size = 16
+    worker.spec = spec
+    worker._reader = None
+    worker._profile_dir = ""
+    worker._profiled = True
+    from collections import deque
+
+    from elasticdl_tpu.common.profiler import StepTimer
+    from elasticdl_tpu.common.summary import SummaryWriter
+
+    worker.losses = deque(maxlen=8)
+    worker.step_timer = StepTimer()
+    worker._summary = SummaryWriter(None)
+    task = pb.Task(task_id=0, type=pb.TRAINING)
+    records = worker._train_task_inner(task)
+    assert records == 6 * 16
+    assert calls["stack"] == [4]
+    assert calls["single"] == 2
+    assert int(owner.state.step) == 6
