@@ -23,6 +23,15 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
 _ZOO = os.path.join(_ROOT, "model_zoo")
 
+# Persistent XLA-executable cache: BERT-base at 512-seq compiles for many
+# minutes on the tunneled chip; with the cache a re-run (and the driver's
+# round-end bench) loads the executable from disk instead.
+from elasticdl_tpu.common.virtual_mesh import (  # noqa: E402
+    enable_persistent_compile_cache,
+)
+
+enable_persistent_compile_cache()
+
 
 def _trainer_for(model_def: str, model_params: str = "", use_bf16=False):
     from elasticdl_tpu.common.model_handler import get_model_spec
@@ -464,7 +473,7 @@ def bench_mnist(batch_size: int = 256, iters: int = 50):
     }
 
 
-def bench_bert(batch_size: int = 32, seq_len: int = 512, iters: int = 10):
+def bench_bert(batch_size: int = 64, seq_len: int = 512, iters: int = 30):
     """Compute-bound MFU headline (VERDICT r3 weak #1: a TPU framework
     with no MXU-bound number is unproven on the axis TPUs exist for).
     BERT-base, bf16, fixed 512-seq; MFU from the XLA cost model on the

@@ -40,19 +40,66 @@ def cpu_mesh_env(n_devices: int, base: dict | None = None) -> dict:
     # programs from different ranks share safely.  Per-user path: a
     # world-shared /tmp dir would hit permission failures (and symlink
     # hazards) the moment a second user runs the suite on the same host.
+    cache_dir = default_cache_dir()
+    if cache_dir:
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    return env
+
+
+def _secure_cache_dir(path: str) -> "str | None":
+    """Create the per-user cache dir 0o700 and verify we own it (ADVICE
+    r3: the predictable /tmp path is squattable — another local user
+    could pre-create it, or plant a symlink, before our first run).
+    Returns None (caller skips the persistent cache) when the path can't
+    be made safe; the cache is an accelerator, never a requirement."""
+    import os
+
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.lstat(path)
+        import stat as _stat
+
+        if not _stat.S_ISDIR(st.st_mode):
+            return None  # symlink or file squatting the name
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+            return None  # someone else's directory
+        if st.st_mode & 0o077:
+            os.chmod(path, 0o700)
+        return path
+    except OSError:
+        return None
+
+
+def default_cache_dir() -> "str | None":
+    """Per-user persistent XLA-executable cache path (created 0o700 and
+    ownership-verified), or None when it cannot be made safe."""
     import getpass
+    import os
     import tempfile
 
     try:
         user = getpass.getuser()
     except Exception:
         user = str(os.getuid()) if hasattr(os, "getuid") else "anon"
-    cache_dir = os.path.join(
-        tempfile.gettempdir(), f"elasticdl_tpu_xla_cache_{user}"
+    return _secure_cache_dir(
+        os.path.join(tempfile.gettempdir(), f"elasticdl_tpu_xla_cache_{user}")
     )
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
-    return env
+
+
+def enable_persistent_compile_cache() -> None:
+    """One-call opt-in for entry points (bench, CLI tools): point an
+    already-imported jax at the per-user persistent executable cache.
+    Executables are keyed by HLO + topology + platform, so TPU and
+    virtual-CPU programs share the directory safely."""
+    cache = default_cache_dir()
+    if cache:
+        import os
+
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"
+        )
+        apply_compilation_cache_config(cache)
 
 
 def apply_cpu_mesh_env(n_devices: int) -> None:

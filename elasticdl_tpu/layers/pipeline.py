@@ -71,7 +71,7 @@ class GPipeBlocks(nn.Module):
 
             return jax.vmap(one)(jax.random.split(rng, self.num_layers))
 
-        stack = self.param("stack", init_stack)
+        stack = self.param("gpipe_stack", init_stack)
 
         def apply_one(p, h):
             return block.apply({"params": p}, h)
@@ -83,10 +83,12 @@ class GPipeBlocks(nn.Module):
 
 
 def pipeline_param_sharding(path, value):
-    """PartitionSpec for GPipeBlocks params: any leaf under a `stack`
-    param subtree is layer-sharded over `pipe` on its leading axis.
-    Compose into a zoo `param_sharding` before other rules."""
+    """PartitionSpec for GPipeBlocks params: leaves under a `gpipe_stack`
+    param subtree are layer-sharded over `pipe` on their leading axis.
+    Compose into a zoo `param_sharding` before other rules.  The name is
+    deliberately distinctive (ADVICE r3): matching a generic `stack`
+    would mis-shard any unrelated user param of that name."""
     names = [getattr(k, "key", str(k)) for k in path]
-    if "stack" in names:
+    if "gpipe_stack" in names:
         return P(PIPE_AXIS)
     return None

@@ -2,9 +2,11 @@
 
 Parity: reference python/master/evaluation_service.py (SURVEY.md C5, call
 stack §3.5).  Eval tasks ride the same task queue as training; workers run
-forward-only over the shard and report per-shard metric means weighted by
-example count; the master reduces them into job-level metrics per model
-version.
+forward-only over the shard and report per-shard metrics — plus the raw
+(label, pred) samples, keyed by task, so job-level rank metrics (AUC) are
+recomputed EXACTLY over the merged validation set: a weighted mean of
+per-shard AUCs is biased whenever shards differ, and the north-star
+acceptance is "at matching AUC" (BASELINE.md #4).
 """
 
 from __future__ import annotations
@@ -13,33 +15,148 @@ import threading
 import time
 from typing import Dict, Optional
 
+import numpy as np
+
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger(__name__)
 
+# Exact recomputation is O(total sample rows) per call; below this row
+# count it runs eagerly on every report (tests, modest validation sets —
+# sub-millisecond), above it lazily on reads (latest_metrics) so a large
+# merged set is not re-sorted once per arriving chunk under the lock.
+EAGER_EXACT_ROWS = 1 << 20
+
+
+class _TaskReport:
+    """One eval task's contribution: scalar metrics + sample chunks.
+    Keyed storage makes re-delivery idempotent — a re-queued task whose
+    earlier chunks landed before the failure REPLACES its contribution
+    instead of double-counting it."""
+
+    __slots__ = ("metrics", "num_examples", "label_chunks", "pred_chunks")
+
+    def __init__(self):
+        self.metrics: Dict[str, float] = {}
+        self.num_examples = 0
+        self.label_chunks = []
+        self.pred_chunks = []
+
 
 class _VersionAgg:
-    def __init__(self):
-        self.weighted_sums: Dict[str, float] = {}
-        self.num_examples = 0
+    def __init__(self, max_sample_rows: int = 1 << 24):
+        self.reports: Dict[object, _TaskReport] = {}
+        self.pred_width = 1
+        self.samples_dropped = False
+        self._max_sample_rows = max_sample_rows
+        # result cache: recompute only when contributions changed
+        self._cache_key = None
+        self._cache_val: Dict[str, float] = {}
+        self._dirty = True
 
-    def add(self, metrics: Dict[str, float], n: int):
-        for name, value in metrics.items():
-            self.weighted_sums[name] = (
-                self.weighted_sums.get(name, 0.0) + value * n
+    # ---- ingest --------------------------------------------------------
+
+    def ingest(self, req: pb.ReportEvaluationMetricsRequest):
+        key = req.eval_task_key or ("w", req.worker_id)
+        if not req.samples_only:
+            # first chunk of a (re-)delivery: reset this task's slot
+            self.reports[key] = _TaskReport()
+            report = self.reports[key]
+            report.metrics = dict(req.metrics)
+            report.num_examples = req.num_examples or 1
+        else:
+            report = self.reports.setdefault(key, _TaskReport())
+        if req.eval_labels and not self.samples_dropped:
+            if (
+                self.sample_rows + len(req.eval_labels)
+                > self._max_sample_rows
+            ):
+                self.drop_samples(
+                    f"sample cap ({self._max_sample_rows} rows) exceeded"
+                )
+            else:
+                self.pred_width = max(1, req.pred_width)
+                report.label_chunks.append(
+                    np.asarray(req.eval_labels, np.float32)
+                )
+                report.pred_chunks.append(
+                    np.asarray(req.eval_preds, np.float32)
+                )
+        self._dirty = True
+
+    def drop_samples(self, reason: str):
+        """Memory valve: discard sample chunks; job-level metrics for this
+        version fall back to weighted shard means from here on."""
+        if not self.samples_dropped:
+            logger.warning(
+                "Dropping eval samples (%s); rank metrics for this "
+                "version fall back to weighted shard means", reason,
             )
-        self.num_examples += n
+        self.samples_dropped = True
+        for report in self.reports.values():
+            report.label_chunks = []
+            report.pred_chunks = []
+        self._dirty = True
 
-    def result(self) -> Dict[str, float]:
-        if not self.num_examples:
+    # ---- totals --------------------------------------------------------
+
+    @property
+    def num_examples(self) -> int:
+        return sum(r.num_examples for r in self.reports.values())
+
+    @property
+    def sample_rows(self) -> int:
+        return sum(
+            len(c) for r in self.reports.values() for c in r.label_chunks
+        )
+
+    def result(self, eval_metrics=None, exact: bool = True
+               ) -> Dict[str, float]:
+        """Aggregate metrics: weighted shard means, overridden by exact
+        recomputation over the merged samples when `exact` and metric fns
+        are available.  Cached until contributions change."""
+        total = self.num_examples
+        if not total:
             return {}
-        return {
-            k: v / self.num_examples for k, v in self.weighted_sums.items()
-        }
+        key = (id(eval_metrics), exact)
+        if not self._dirty and self._cache_key == key:
+            return self._cache_val
+        out: Dict[str, float] = {}
+        for report in self.reports.values():
+            for name, value in report.metrics.items():
+                out[name] = out.get(name, 0.0) + value * report.num_examples
+        out = {k: v / total for k, v in out.items()}
+        rows = self.sample_rows
+        if exact and eval_metrics and rows:
+            labels = np.concatenate(
+                [c for r in self.reports.values() for c in r.label_chunks]
+            )
+            preds = np.concatenate(
+                [c for r in self.reports.values() for c in r.pred_chunks]
+            ).reshape(len(labels), self.pred_width)
+            if self.pred_width == 1:
+                preds = preds[:, 0]
+            for name, fn in eval_metrics.items():
+                try:
+                    out[name] = float(fn(labels, preds))
+                except Exception:
+                    logger.exception(
+                        "exact recomputation of metric %r failed; "
+                        "keeping weighted shard mean", name,
+                    )
+        self._cache_key = key
+        self._cache_val = out
+        self._dirty = False
+        return out
 
 
 class EvaluationService:
+    # Keep merged samples for this many most-recent versions: late
+    # straggler chunks for older versions degrade (logged) to weighted
+    # means instead of growing master memory without bound.
+    SAMPLE_VERSIONS_KEPT = 2
+
     def __init__(
         self,
         task_manager,
@@ -48,9 +165,16 @@ class EvaluationService:
         throttle_secs: int = 0,
         eval_only_at_end: bool = False,
         summary_writer=None,
+        eval_metrics=None,
     ):
         self._tm = task_manager
         self._summary = summary_writer
+        # {name: fn(labels, preds)} from the zoo's eval_metrics_fn: when
+        # present AND workers ship (label, pred) samples, job-level
+        # metrics are recomputed exactly over the merged validation set
+        # instead of weighted per-shard means (SURVEY §3.5; BASELINE
+        # "AUC on the held-out split" — rank metrics don't decompose).
+        self._eval_metrics = eval_metrics
         self._evaluation_steps = evaluation_steps
         self._start_delay_secs = start_delay_secs
         self._throttle_secs = throttle_secs
@@ -97,11 +221,24 @@ class EvaluationService:
     def report_metrics(self, req: pb.ReportEvaluationMetricsRequest):
         with self._lock:
             agg = self._aggs.setdefault(req.model_version, _VersionAgg())
-            agg.add(dict(req.metrics), req.num_examples or 1)
-            self.history[req.model_version] = agg.result()
+            if self._eval_metrics is None and req.eval_labels:
+                # no metric fns on the master -> samples can never be
+                # used; don't buffer them
+                req.ClearField("eval_labels")
+                req.ClearField("eval_preds")
+            agg.ingest(req)
+            # Exact recompute is O(rows): eager for small merged sets,
+            # deferred to latest_metrics() for large ones so per-chunk
+            # reports don't re-sort millions of rows under the lock.
+            eager = agg.sample_rows <= EAGER_EXACT_ROWS
+            self.history[req.model_version] = agg.result(
+                self._eval_metrics, exact=eager
+            )
+            self._prune_samples_locked(req.model_version)
+            n, sampled = agg.num_examples, agg.sample_rows
         logger.info(
-            "Eval metrics v%d (n=%d): %s",
-            req.model_version, agg.num_examples, self.history[req.model_version],
+            "Eval metrics v%d (n=%d, sampled=%d): %s",
+            req.model_version, n, sampled, self.history[req.model_version],
         )
         if self._summary is not None:
             # Master-side TensorBoard: job-level (cross-shard aggregated)
@@ -115,8 +252,22 @@ class EvaluationService:
             )
             self._summary.flush()
 
+    def _prune_samples_locked(self, current_version: int):
+        keep = sorted(self._aggs)[-self.SAMPLE_VERSIONS_KEPT:]
+        for version, agg in self._aggs.items():
+            if version not in keep and not agg.samples_dropped:
+                # freeze the exact result computed so far, then free
+                self.history[version] = agg.result(self._eval_metrics)
+                agg.drop_samples(f"version {version} superseded")
+
     def latest_metrics(self) -> Optional[Dict[str, float]]:
         with self._lock:
-            if not self.history:
+            if not self._aggs and not self.history:
                 return None
-            return self.history[max(self.history)]
+            if not self._aggs:
+                return self.history[max(self.history)]
+            version = max(self._aggs)
+            self.history[version] = self._aggs[version].result(
+                self._eval_metrics
+            )
+            return self.history[version]

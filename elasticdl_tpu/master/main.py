@@ -135,6 +135,7 @@ class Master:
             start_delay_secs=args.evaluation_start_delay_secs,
             throttle_secs=args.evaluation_throttle_secs,
             summary_writer=eval_summary,
+            eval_metrics=self._load_eval_metrics(args),
         )
         self.rendezvous_server = None
         self.pod_manager = None
@@ -198,6 +199,34 @@ class Master:
             and getattr(args, "output", "")
         ):
             self.task_manager.add_pre_finish_provider(self._save_model_tasks)
+
+    @staticmethod
+    def _load_eval_metrics(args):
+        """Lazily load the zoo module's eval_metrics_fn so job-level
+        rank metrics (AUC) can be recomputed exactly over merged worker
+        samples.  The reference master loaded user model code too
+        (ModelHandler, SURVEY C14); failures degrade to weighted
+        per-shard means, never abort the job brain."""
+        model_zoo = getattr(args, "model_zoo", "")
+        model_def = getattr(args, "model_def", "")
+        if not model_zoo or not model_def:
+            return None
+        try:
+            from elasticdl_tpu.common.model_handler import load_module
+
+            module, _ = load_module(model_zoo, model_def)
+            factory = getattr(
+                module,
+                getattr(args, "eval_metrics_fn", "") or "eval_metrics_fn",
+                None,
+            )
+            return factory() if factory else None
+        except Exception:
+            logger.exception(
+                "Could not load eval_metrics_fn on the master; job-level "
+                "metrics fall back to weighted per-shard means"
+            )
+            return None
 
     def _save_model_tasks(self):
         if self._save_model_done:

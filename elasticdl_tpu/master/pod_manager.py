@@ -20,6 +20,15 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger(__name__)
 
 
+def _is_not_found(exc: Exception) -> bool:
+    """True when a k8s-client error means 'pod already gone' (ApiException
+    status 404 or an equivalent message) as opposed to a transient
+    apiserver failure worth retrying."""
+    if getattr(exc, "status", None) == 404:
+        return True
+    return "not found" in str(exc).lower()
+
+
 class PodManager:
     def __init__(
         self,
@@ -369,13 +378,34 @@ class PodManager:
                 "Group %d restart: deleting peer worker %d (%s) of "
                 "failed worker %d", group, w, pod, lost_worker,
             )
-            try:
-                self._k8s.delete_pod(pod)
-            except Exception:
-                # peer already gone (its own watchdog beat us) — its
-                # FAILED event relaunches via the intentional-exit path
-                with self._lock:
-                    self._group_restart_pods.discard(pod)
+            # One retry on transient apiserver errors before giving up:
+            # dropping the budget-free marker on a transient failure
+            # would leave the wedged peer waiting out its full
+            # wedge-watchdog grace (ADVICE r3).  NotFound means the peer
+            # is already gone (its own watchdog beat us) — fine, its
+            # FAILED event relaunches via the intentional-exit path.
+            for attempt in (0, 1):
+                try:
+                    self._k8s.delete_pod(pod)
+                    break
+                except Exception as exc:
+                    if _is_not_found(exc):
+                        with self._lock:
+                            self._group_restart_pods.discard(pod)
+                        break
+                    if attempt == 0:
+                        logger.warning(
+                            "Group %d restart: transient delete failure "
+                            "for %s (%s); retrying once", group, pod, exc,
+                        )
+                        continue
+                    logger.warning(
+                        "Group %d restart: could not delete peer %s "
+                        "(%s); it will recover via its wedge watchdog",
+                        group, pod, exc,
+                    )
+                    with self._lock:
+                        self._group_restart_pods.discard(pod)
 
     # ---- introspection -------------------------------------------------
 

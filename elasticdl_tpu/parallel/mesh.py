@@ -123,6 +123,64 @@ def make_global_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
     return jax.tree.map(to_global, batch)
 
 
+def local_batch_range(mesh: Mesh, global_batch_size: int):
+    """Rows [start, stop) of a data-sharded global batch that THIS
+    process's addressable devices hold, or None when they are not one
+    contiguous row range (exotic device layouts — callers then fall back
+    to full-batch reads).  This is what lets each rank read only its
+    1/world_size slice of a task's records (SURVEY §3.3: per-worker
+    disjoint reads) instead of every rank reading the whole shard."""
+    sharding = data_sharding(mesh)
+    index_map = sharding.addressable_devices_indices_map(
+        (global_batch_size,)
+    )
+    spans = set()
+    for idx in index_map.values():
+        sl = idx[0]
+        start = 0 if sl.start is None else sl.start
+        stop = global_batch_size if sl.stop is None else sl.stop
+        spans.add((start, stop))
+    starts = sorted(spans)
+    lo, hi = starts[0][0], starts[0][1]
+    for start, stop in starts[1:]:
+        if start > hi:
+            return None  # hole between this process's row spans
+        hi = max(hi, stop)
+    return lo, hi
+
+
+def make_global_batch_from_local(
+    batch: Dict[str, np.ndarray], mesh: Mesh, global_batch_size: int,
+    local_start: int,
+):
+    """Assemble global `jax.Array`s from ONLY this process's local rows
+    (`local_batch_range` slice starting at `local_start` in global
+    coordinates).  The callback is invoked for addressable shards only,
+    so no host materializes — or reads — rows outside its slice."""
+    sharding = data_sharding(mesh)
+
+    def to_global(x):
+        x = np.asarray(x)
+        shape = (global_batch_size,) + x.shape[1:]
+
+        def fetch(idx):
+            sl = idx[0]
+            start = (0 if sl.start is None else sl.start) - local_start
+            stop = (
+                global_batch_size if sl.stop is None else sl.stop
+            ) - local_start
+            if start < 0 or stop > len(x):
+                raise IndexError(
+                    "requested global rows outside this rank's local "
+                    "slice (local_batch_range mismatch)"
+                )
+            return x[start:stop]
+
+        return jax.make_array_from_callback(shape, sharding, fetch)
+
+    return jax.tree.map(to_global, batch)
+
+
 def pad_to_multiple(batch: Dict[str, np.ndarray], multiple: int):
     """Pad batch leading dim up to a multiple (wrapping existing rows) so
     shapes stay static under jit; returns (padded_batch, real_count)."""

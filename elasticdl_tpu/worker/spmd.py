@@ -218,7 +218,7 @@ class SPMDWorker:
             len(jax.devices()), dict(self.mesh.shape),
         )
 
-    def _ensure_state(self, batch) -> None:
+    def _ensure_state(self, batch, global_rows: Optional[int] = None) -> None:
         if getattr(self, "sample_features", None) is None:
             # one host row, kept for export signatures (SavedModel)
             self.sample_features = jax.tree.map(
@@ -226,8 +226,22 @@ class SPMDWorker:
             )
         if self.state is not None:
             return
+        features = batch["features"]
+        if global_rows is not None:
+            # Slice-local data path: ranks hold DIFFERENT local rows, but
+            # the jitted init embeds its features as constants — every
+            # rank must trace the identical program, so init from zeros
+            # of the global batch shape (param init depends on shapes and
+            # rng only, never on feature values).
+            features = jax.tree.map(
+                lambda a: np.zeros(
+                    (global_rows,) + np.asarray(a).shape[1:],
+                    np.asarray(a).dtype,
+                ),
+                features,
+            )
         self.state = self.trainer.init_state_global(
-            jax.random.PRNGKey(self._seed), batch["features"]
+            jax.random.PRNGKey(self._seed), features
         )
         if self._saver is not None:
             restored = self._saver.maybe_restore(self.state)
@@ -426,12 +440,31 @@ class SPMDWorker:
 
     def _train_task_inner(self, task: pb.Task) -> int:
         records = 0
-        for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed,
-            feed_bulk=self._feed_bulk,
-        ):
-            self._ensure_state(batch)
-            global_batch = mesh_lib.make_global_batch(batch, self.mesh)
+        # Slice-local reads (SURVEY §3.3 per-worker disjoint reads): each
+        # rank reads only its addressable rows of every full global batch
+        # — aggregate host IO is O(shard), not O(world_size * shard).
+        local = mesh_lib.local_batch_range(self.mesh, self.minibatch_size)
+        if local is not None:
+            batches = self._data_service.local_batches_for_task(
+                task, self.minibatch_size, self._feed,
+                self._feed_bulk, local[0], local[1],
+            )
+        else:  # non-contiguous local rows: every rank reads everything
+            batches = (
+                (batch, real, False)
+                for batch, real in self._data_service.batches_for_task(
+                    task, self.minibatch_size, self._feed,
+                    feed_bulk=self._feed_bulk,
+                )
+            )
+        for batch, real, is_local in batches:
+            self._ensure_state(batch, global_rows=self.minibatch_size)
+            if is_local:
+                global_batch = mesh_lib.make_global_batch_from_local(
+                    batch, self.mesh, self.minibatch_size, local[0]
+                )
+            else:
+                global_batch = mesh_lib.make_global_batch(batch, self.mesh)
             self.state, loss = self.trainer.train_on_global_batch(
                 self.state, global_batch
             )
@@ -490,18 +523,25 @@ class SPMDWorker:
             all_preds.append(np.asarray(preds)[:real])
             records += real
         if records and self.is_leader:
+            from elasticdl_tpu.worker.worker import (
+                report_evaluation_with_samples,
+            )
+
             labels = np.concatenate(all_labels)
             preds = np.concatenate(all_preds)
-            req = pb.ReportEvaluationMetricsRequest(
-                worker_id=self.worker_id,
-                model_version=actual_version
+            version = (
+                actual_version
                 if actual_version is not None and actual_version >= 0
-                else int(self.state.step),
-                num_examples=records,
+                else int(self.state.step)
             )
-            for name, fn in self.spec.eval_metrics.items():
-                req.metrics[name] = float(fn(labels, preds))
-            self._client.report_evaluation_metrics(req)
+            metrics = {
+                name: float(fn(labels, preds))
+                for name, fn in self.spec.eval_metrics.items()
+            }
+            report_evaluation_with_samples(
+                self._client, self.worker_id, version,
+                metrics, records, labels, preds, task_id=task.task_id,
+            )
         return records
 
     def _predict_task(self, task: pb.Task) -> int:
@@ -520,11 +560,14 @@ class SPMDWorker:
                 self.trainer.predict_on_global_batch(self.state, features)
             )
             rows.append(np.asarray(preds)[:real])
-            if processor is not None and self.is_leader:
-                # reference C18 contract; leader-only so the zoo's sink
-                # sees each batch once, not once per rank
-                processor.process(rows[-1], self.worker_id)
             records += real
+        if rows and processor is not None and self.is_leader:
+            # reference C18 contract; leader-only so the zoo's sink sees
+            # each batch once, not once per rank — and buffered per task
+            # (ADVICE r3) so a mid-task failure + re-queue cannot deliver
+            # partial duplicates.  At-least-once at task granularity.
+            for chunk in rows:
+                processor.process(chunk, self.worker_id)
         if rows:
             # Keyed by task_id so a task re-processed after a remesh (the
             # lease was recovered before the leader reported) OVERWRITES

@@ -131,7 +131,7 @@ class TestPipelinedBert:
         batch = self._batch()
         state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
         # layer stack is sharded over pipe on its leading axis
-        stack_leaf = state.params["params"]["encoder_pipeline"]["stack"]
+        stack_leaf = state.params["params"]["encoder_pipeline"]["gpipe_stack"]
         leaf = jax.tree.leaves(stack_leaf)[0]
         assert leaf.shape[0] == 4  # num_layers
         spec_str = str(leaf.sharding.spec)

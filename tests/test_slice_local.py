@@ -1,0 +1,141 @@
+"""Slice-local SPMD data loading (VERDICT r3 weak #4): each rank reads
+only its addressable rows of every full global batch, so aggregate host IO
+is O(shard) instead of O(world_size * shard), while the assembled global
+batches — and therefore training — stay bitwise identical (the
+cross-process bitwise pin lives in test_spmd/test_cluster_e2e, which now
+ride this path)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.record_io import write_tfrecords_bulk
+from elasticdl_tpu.data.reader.tfrecord_reader import TFRecordDataReader
+from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+REC = 157
+
+
+class CountingReader(TFRecordDataReader):
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.records_read = 0
+
+    def read_records(self, task):
+        for r in super().read_records(task):
+            self.records_read += 1
+            yield r
+
+    def read_records_bulk(self, task):
+        out = super().read_records_bulk(task)
+        if out is not None:
+            self.records_read += len(out[1])
+        return out
+
+
+@pytest.fixture
+def criteo_file(tmp_path):
+    rng = np.random.RandomState(3)
+    n = 1000
+    arr = rng.randint(0, 256, size=(n, REC), dtype=np.uint8)
+    path = str(tmp_path / "c.tfrecord")
+    write_tfrecords_bulk(path, arr.reshape(-1), np.full(n, REC, np.int64))
+    return path, arr
+
+
+def _task(path, start, end):
+    return pb.Task(
+        task_id=1, type=pb.TRAINING,
+        shard=pb.Shard(name=path, start=start, end=end),
+    )
+
+
+def _feed(records):
+    return {"rows": np.stack([np.frombuffer(r, np.uint8) for r in records])}
+
+
+def _feed_bulk(buf, sizes):
+    return {"rows": np.frombuffer(buf, np.uint8).reshape(len(sizes), REC)}
+
+
+@pytest.mark.parametrize("use_bulk", [True, False])
+def test_rank_slices_reassemble_full_stream(criteo_file, use_bulk):
+    path, arr = criteo_file
+    world, batch = 4, 64
+    task_range = (10, 906)  # 896 records = 14 full batches, no tail
+    per = batch // world
+    fb = _feed_bulk if use_bulk else None
+    rank_streams = []
+    reads = []
+    for rank in range(world):
+        reader = CountingReader(path)
+        service = TaskDataService(None, reader, rank)
+        out = list(service.local_batches_for_task(
+            _task(path, *task_range), batch, _feed, fb,
+            rank * per, (rank + 1) * per,
+        ))
+        assert all(is_local for _, _, is_local in out)
+        assert all(real == batch for _, real, _ in out)
+        rank_streams.append([b["rows"] for b, _, _ in out])
+        reads.append(reader.records_read)
+    # per-rank IO is exactly 1/world of the task
+    total = task_range[1] - task_range[0]
+    assert reads == [total // world] * world
+    # stitching rank slices row-wise reproduces the plain full read
+    reader = CountingReader(path)
+    service = TaskDataService(None, reader, 0)
+    full = [
+        b["rows"] for b, _ in service.batches_for_task(
+            _task(path, *task_range), batch, _feed,
+            feed_bulk=fb,
+        )
+    ]
+    assert len(full) == len(rank_streams[0]) == total // batch
+    for i, full_batch in enumerate(full):
+        stitched = np.concatenate([rank_streams[r][i] for r in range(world)])
+        np.testing.assert_array_equal(stitched, full_batch)
+
+
+def test_partial_tail_read_in_full_everywhere(criteo_file):
+    path, _ = criteo_file
+    world, batch = 4, 64
+    task = _task(path, 0, 150)  # 2 full batches + 22-record tail
+    per = batch // world
+    for rank in range(world):
+        reader = CountingReader(path)
+        service = TaskDataService(None, reader, rank)
+        out = list(service.local_batches_for_task(
+            task, batch, _feed, _feed_bulk, rank * per, (rank + 1) * per
+        ))
+        kinds = [is_local for _, _, is_local in out]
+        reals = [real for _, real, _ in out]
+        assert kinds == [True, True, False]
+        assert reals == [64, 64, 22]
+        # tail batch wrap-padded to full batch size, identically everywhere
+        assert out[-1][0]["rows"].shape[0] == batch
+        assert reader.records_read == 2 * per + 22
+
+
+def test_local_batch_range_single_process_covers_all():
+    mesh = mesh_lib.create_mesh()
+    assert mesh_lib.local_batch_range(mesh, 64) == (0, 64)
+
+
+def test_make_global_batch_from_local_matches_full():
+    import jax
+
+    mesh = mesh_lib.create_mesh()
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": rng.rand(64, 5).astype(np.float32),
+        "labels": rng.randint(0, 2, 64).astype(np.int32),
+    }
+    full = mesh_lib.make_global_batch(batch, mesh)
+    local = mesh_lib.make_global_batch_from_local(batch, mesh, 64, 0)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        full, local,
+    )
