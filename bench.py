@@ -473,11 +473,47 @@ def bench_mnist(batch_size: int = 256, iters: int = 50):
     }
 
 
+def _measured_matmul_roofline_tflops(iters: int = 20) -> float:
+    """Best sustained bf16 matmul rate THIS device actually delivers
+    (8192^3 chained matmuls, value-fetch synced).  Recorded alongside
+    the datasheet peak: the tunneled dev chip measures ~53% of the v5e
+    datasheet rate even on pure matmuls, so utilization is reported
+    against both (mfu = datasheet; mfu_vs_measured_roofline = this)."""
+    import jax
+    import jax.numpy as jnp
+
+    m = 8192
+    a = jnp.asarray(np.random.rand(m, m), jnp.bfloat16)
+    b = jnp.asarray(np.random.rand(m, m), jnp.bfloat16)
+
+    def loop(a, b):
+        def body(_, acc):
+            c = jax.lax.dot_general(
+                a + 0.0 * acc[0, 0].astype(a.dtype), b,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return acc + c
+
+        return jax.lax.fori_loop(
+            0, iters, body, jnp.zeros((m, m), jnp.float32)
+        )[0, 0]
+
+    import time as _time
+
+    fn = jax.jit(loop)
+    jax.device_get(fn(a, b))
+    t0 = _time.perf_counter()
+    jax.device_get(fn(a, b))
+    return 2 * m * m * m * iters / (_time.perf_counter() - t0) / 1e12
+
+
 def bench_bert(batch_size: int = 64, seq_len: int = 512, iters: int = 30):
     """Compute-bound MFU headline (VERDICT r3 weak #1: a TPU framework
     with no MXU-bound number is unproven on the axis TPUs exist for).
-    BERT-base, bf16, fixed 512-seq; MFU from the XLA cost model on the
-    honest fused timing."""
+    BERT-base, bf16 end-to-end, fixed 512-seq; MFU from the XLA cost
+    model on the honest fused timing, reported against BOTH the
+    datasheet peak and the device's measured matmul roofline."""
     import jax
 
     from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -486,7 +522,7 @@ def bench_bert(batch_size: int = 64, seq_len: int = 512, iters: int = 30):
         "bert.bert_finetune.custom_model",
         model_params=(
             f"hidden=768;num_layers=12;heads=12;mlp_dim=3072;"
-            f"max_len={seq_len}"
+            f"max_len={seq_len};bf16=True"
         ),
         use_bf16=True,
     )
@@ -521,6 +557,14 @@ def bench_bert(batch_size: int = 64, seq_len: int = 512, iters: int = 30):
         detail["mfu"] = round(
             flops * steps_per_sec / peaks["bf16_flops"], 4
         )
+        try:
+            roofline = _measured_matmul_roofline_tflops()
+            detail["matmul_roofline_tflops_measured"] = round(roofline, 1)
+            detail["mfu_vs_measured_roofline"] = round(
+                flops * steps_per_sec / (roofline * 1e12), 4
+            )
+        except Exception as exc:
+            detail["roofline_error"] = repr(exc)
     return {
         "metric": "bert_base_finetune_examples_per_sec",
         "value": round(steps_per_sec * batch_size, 1),

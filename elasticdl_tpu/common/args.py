@@ -93,6 +93,15 @@ def add_common_params(parser: argparse.ArgumentParser):
         "grace.  1 = per-worker granularity (the reference's model).",
     )
     parser.add_argument(
+        "--preemption_notice_file", default="",
+        help="Path polled for an upcoming-disruption notice (GKE TPU "
+        "maintenance event / spot reclaim projected into the pod by a "
+        "downward-API volume or node-watcher sidecar).  When the file "
+        "appears the worker drains at the next task boundary and "
+        "flushes a checkpoint — ahead of the SIGTERM.  'gce-metadata' "
+        "polls the instance metadata server instead of a file.",
+    )
+    parser.add_argument(
         "--wedge_grace_s", type=float, default=20.0,
         help="Seconds a rank may lag a membership-epoch change before its "
         "watchdog assumes it is wedged in a collective with a dead peer "

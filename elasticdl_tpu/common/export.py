@@ -54,8 +54,6 @@ def export_model(
         "model_class": type(spec.model).__name__,
         "framework": "elasticdl-tpu",
     }
-    with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
     if saved_model:
         if sample_features is None:
             # raise so export_for_task re-queues to a worker that HAS
@@ -71,15 +69,29 @@ def export_model(
                 state, spec, os.path.join(output_dir, "saved_model"),
                 sample_features,
             )
+            meta["saved_model"] = "ok"
+        except ImportError as exc:
+            # no TensorFlow in the image: a documented, non-retryable
+            # deployment condition — record it in the export metadata
+            # (ADVICE r3: a log line alone let the job read as fully
+            # successful) and keep the msgpack export
+            meta["saved_model"] = f"unavailable: {exc}"
+            logger.error(
+                "SavedModel export unavailable (%s); wrote params.msgpack "
+                "only", exc,
+            )
         except Exception as exc:
-            # mesh-manual models (ring attention / GPipe shard_map) do
-            # not stage through jax2tf; the msgpack export above is
-            # still valid, so surface the failure without killing a
-            # finished training job
+            # Conversion/disk failures: the msgpack export above is still
+            # valid, so don't kill a finished training job — but surface
+            # the miss durably in export_meta.json, not only in a log
+            # record, so the job's final artifacts say what's missing.
+            meta["saved_model"] = f"failed: {exc}"
             logger.error(
                 "SavedModel export failed (%s); wrote params.msgpack "
                 "only", exc,
             )
+    with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2)
     return path
 
 
@@ -95,6 +107,8 @@ def export_saved_model(
     import tensorflow as tf
     from jax.experimental import jax2tf
 
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+
     model = spec.model
     variables = {
         **jax.tree.map(np.asarray, state.params),
@@ -106,7 +120,12 @@ def export_saved_model(
 
     def apply_fn(variables, features):
         kwargs = {"train": False} if has_train else {}
-        return model.apply(variables, features, **kwargs)
+        # export mode: mesh-manual ops (ring attention, GPipe schedule,
+        # Pallas flash kernel) switch to their single-device lax
+        # formulations — shard_map/custom-calls cannot stage through
+        # jax2tf, and the param tree is identical by design
+        with mesh_lib.export_mode():
+            return model.apply(variables, features, **kwargs)
 
     def poly_spec(x):
         nd = np.ndim(x)
@@ -117,6 +136,12 @@ def export_saved_model(
         apply_fn,
         polymorphic_shapes=[None, jax.tree.map(poly_spec, sample_features)],
         with_gradient=False,
+        # Multi-platform lowering: a model trained on TPU must serve on
+        # CPU/GPU TF Serving hosts — single-platform native serialization
+        # embeds the training platform and refuses to load elsewhere
+        # (observed: module exported under the TPU session failed to load
+        # on CPU with "platform CPU is not among the platforms required").
+        native_serialization_platforms=("cpu", "cuda", "tpu"),
     )
     module = tf.Module()
     module.v = tf.nest.map_structure(tf.Variable, variables)

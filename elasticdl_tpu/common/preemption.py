@@ -46,3 +46,133 @@ def install_preemption_hook(
     for sig in signals:
         signal.signal(sig, handler)
     return handler
+
+
+# ---- maintenance-event / preemption-notice awareness -------------------
+#
+# GKE TPU node pools surface upcoming disruption BEFORE the kill: GCE
+# maintenance events and spot/preemption notices are published on the
+# instance metadata server, and cluster tooling commonly projects them
+# into a file in the pod (downward API / a node-watcher sidecar).  The
+# reference had nothing equivalent (k8s pod-phase watch only, SURVEY §5);
+# for TPU slices SURVEY §7's C4 mapping calls for acting on the notice —
+# draining at a task boundary and flushing a checkpoint while the grace
+# window is still all ours, instead of racing the SIGTERM delivery.
+
+
+def file_notice_checker(path: str) -> Callable[[], bool]:
+    """Notice = the file exists AND is non-empty.  A downward-API
+    projection creates the file at pod start with the (empty) label
+    value — existence alone would read as an immediate notice and
+    drain-loop the job; content appears only when the node watcher
+    writes the event (e.g. TERMINATE_ON_MAINTENANCE)."""
+    import os
+
+    def check() -> bool:
+        try:
+            return os.path.getsize(path) > 0
+        except OSError:
+            return False
+
+    return check
+
+
+def gce_metadata_checker(
+    kind: str = "preempted",
+    timeout_s: float = 1.0,
+) -> Callable[[], bool]:
+    """Poll the GCE metadata server for a disruption notice.
+
+    kind: "preempted" (spot/preemptible reclaim) or "maintenance-event"
+    (host maintenance; value != NONE means a migration is imminent).
+    Unreachable metadata (non-GCE hosts, tests) reads as no-notice.
+    """
+    import urllib.request
+
+    url = (
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        + ("preempted" if kind == "preempted" else "maintenance-event")
+    )
+
+    def check() -> bool:
+        try:
+            req = urllib.request.Request(
+                url, headers={"Metadata-Flavor": "Google"}
+            )
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                value = resp.read().decode().strip().upper()
+            if kind == "preempted":
+                return value == "TRUE"
+            return value not in ("", "NONE")
+        except Exception:
+            return False
+
+    return check
+
+
+def any_notice_checker(*checkers) -> Callable[[], bool]:
+    """Notice = ANY source fires.  The GKE wiring watches BOTH the spot
+    reclaim ('preempted') and scheduled host maintenance
+    ('maintenance-event') endpoints — a non-spot TPU VM only ever sees
+    the latter."""
+
+    def check() -> bool:
+        return any(c() for c in checkers)
+
+    return check
+
+
+class MaintenanceNoticeWatcher:
+    """Daemon thread polling a notice source; fires `on_notice` ONCE when
+    the notice appears.  `on_notice` is the same drain hook the SIGTERM
+    path uses (stop at the next task boundary + flush checkpoint), so the
+    notice simply starts recovery earlier than the kill would."""
+
+    def __init__(
+        self,
+        check: Callable[[], bool],
+        on_notice: Callable[[], None],
+        poll_s: float = 5.0,
+    ):
+        self._check = check
+        self._on_notice = on_notice
+        self._poll_s = poll_s
+        self._fired = False
+        self._stop = False
+        self._thread = None
+
+    def start(self) -> "MaintenanceNoticeWatcher":
+        import threading
+
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _run(self) -> None:
+        import time
+
+        while not self._stop and not self._fired:
+            try:
+                notice = self._check()
+            except Exception:
+                notice = False
+            if notice:
+                self._fired = True
+                logger.warning(
+                    "Maintenance/preemption notice observed: draining at "
+                    "the next task boundary and flushing checkpoint "
+                    "(ahead of the kill)"
+                )
+                try:
+                    self._on_notice()
+                except Exception as exc:
+                    logger.error("Notice drain hook failed: %s", exc)
+                return
+            time.sleep(self._poll_s)

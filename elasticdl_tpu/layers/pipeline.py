@@ -25,7 +25,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.ops.pipeline import gpipe_spmd
-from elasticdl_tpu.parallel.mesh import PIPE_AXIS, get_current_mesh
+from elasticdl_tpu.parallel.mesh import (
+    PIPE_AXIS,
+    get_current_mesh,
+    in_export_mode,
+)
 
 
 class GPipeBlocks(nn.Module):
@@ -47,6 +51,33 @@ class GPipeBlocks(nn.Module):
         block = self.block_cls(**dict(self.block_kwargs))
         mesh = get_current_mesh()
         stages = mesh.shape.get(PIPE_AXIS, 1)
+
+        # Param shapes are batch-size independent, so init always traces
+        # the block at batch 1 — this also keeps param() usable when the
+        # batch dimension is SYMBOLIC (serving export traces a
+        # polymorphic batch; flax eval_shapes the init_fn to validate
+        # stored params even on bound modules).
+        def init_stack(rng):
+            def one(r):
+                return block.init(
+                    r, jnp.zeros((1,) + x.shape[1:], x.dtype)
+                )["params"]
+
+            return jax.vmap(one)(jax.random.split(rng, self.num_layers))
+
+        stack = self.param("gpipe_stack", init_stack)
+
+        def apply_one(p, h):
+            return block.apply({"params": p}, h)
+
+        if in_export_mode():
+            # Serving export: microbatch arithmetic (min/mod on the
+            # batch size) is inconclusive on symbolic dims, and
+            # gpipe_spmd runs the sequential formulation anyway.
+            return gpipe_spmd(
+                apply_one, stack, x, mesh,
+                num_microbatches=1, remat=self.remat,
+            )
         # microbatches divide the PER-DATA-SHARD batch inside shard_map
         local = max(x.shape[0] // max(mesh.shape.get("data", 1), 1), 1)
         mcount = min(self.num_microbatches, local) if stages > 1 else 1
@@ -63,18 +94,6 @@ class GPipeBlocks(nn.Module):
                 self.num_microbatches, local, mcount,
                 100.0 * (stages - 1) / (mcount + stages - 1),
             )
-        mb_shape = (local // mcount,) + x.shape[1:]
-
-        def init_stack(rng):
-            def one(r):
-                return block.init(r, jnp.zeros(mb_shape, x.dtype))["params"]
-
-            return jax.vmap(one)(jax.random.split(rng, self.num_layers))
-
-        stack = self.param("gpipe_stack", init_stack)
-
-        def apply_one(p, h):
-            return block.apply({"params": p}, h)
 
         return gpipe_spmd(
             apply_one, stack, x, mesh,

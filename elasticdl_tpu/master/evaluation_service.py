@@ -50,6 +50,11 @@ class _VersionAgg:
         self.pred_width = 1
         self.samples_dropped = False
         self._max_sample_rows = max_sample_rows
+        # unkeyed wire compat: reports without eval_task_key accumulate
+        # (one fresh slot per delivery), continuation chunks attach to
+        # the worker's most recent slot
+        self._unkeyed_seq = 0
+        self._unkeyed_last: Dict[int, object] = {}
         # result cache: recompute only when contributions changed
         self._cache_key = None
         self._cache_val: Dict[str, float] = {}
@@ -58,7 +63,21 @@ class _VersionAgg:
     # ---- ingest --------------------------------------------------------
 
     def ingest(self, req: pb.ReportEvaluationMetricsRequest):
-        key = req.eval_task_key or ("w", req.worker_id)
+        if req.eval_task_key:
+            key = req.eval_task_key
+        elif req.samples_only:
+            # continuation of this worker's last unkeyed delivery
+            key = self._unkeyed_last.get(req.worker_id)
+            if key is None:
+                self._unkeyed_seq += 1
+                key = ("w", req.worker_id, self._unkeyed_seq)
+                self._unkeyed_last[req.worker_id] = key
+        else:
+            # unkeyed senders (pre-field clients) ACCUMULATE: each
+            # delivery gets a fresh slot, never replacing earlier shards
+            self._unkeyed_seq += 1
+            key = ("w", req.worker_id, self._unkeyed_seq)
+            self._unkeyed_last[req.worker_id] = key
         if not req.samples_only:
             # first chunk of a (re-)delivery: reset this task's slot
             self.reports[key] = _TaskReport()
@@ -181,6 +200,8 @@ class EvaluationService:
         self._eval_only_at_end = eval_only_at_end
         self._lock = threading.Lock()
         self._aggs: Dict[int, _VersionAgg] = {}
+        # versions whose history entry holds an exactly-recomputed value
+        self._history_exact = set()
         self._last_eval_version = 0
         self._last_eval_time = 0.0
         self._start_time = time.time()
@@ -227,13 +248,27 @@ class EvaluationService:
                 req.ClearField("eval_labels")
                 req.ClearField("eval_preds")
             agg.ingest(req)
-            # Exact recompute is O(rows): eager for small merged sets,
-            # deferred to latest_metrics() for large ones so per-chunk
-            # reports don't re-sort millions of rows under the lock.
-            eager = agg.sample_rows <= EAGER_EXACT_ROWS
-            self.history[req.model_version] = agg.result(
-                self._eval_metrics, exact=eager
+            # Exact recompute is O(rows): eager for small merged sets and
+            # once per COMPLETED delivery (final_chunk) for large ones —
+            # never once per arriving chunk, which would re-sort millions
+            # of rows under the lock; TensorBoard/history therefore carry
+            # the exact value after every finished shard, not the biased
+            # weighted mean.
+            eager = (
+                agg.sample_rows <= EAGER_EXACT_ROWS
+                or req.final_chunk
+                or not req.eval_labels
             )
+            result = agg.result(self._eval_metrics, exact=eager)
+            if eager:
+                self.history[req.model_version] = result
+                self._history_exact.add(req.model_version)
+            elif req.model_version not in self._history_exact:
+                # mid-delivery chunk of a large sample set: never let the
+                # biased weighted mean overwrite an exact value already
+                # published for this version — hold the exact one until
+                # the delivery's final chunk recomputes
+                self.history[req.model_version] = result
             self._prune_samples_locked(req.model_version)
             n, sampled = agg.num_examples, agg.sample_rows
         logger.info(
@@ -270,4 +305,5 @@ class EvaluationService:
             self.history[version] = self._aggs[version].result(
                 self._eval_metrics
             )
+            self._history_exact.add(version)
             return self.history[version]

@@ -42,7 +42,11 @@ def _fwd_kernel(
     scale: float, q_len: int, k_len: int, block_q: int,
 ):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (BLOCK_Q, D)
+    # operands stay in the INPUT dtype (bf16 in mixed-precision training)
+    # so the MXU runs at full rate — f32 upcasts before the dots would
+    # quarter the matmul rate on v5e; accumulation is f32 via
+    # preferred_element_type, softmax math is f32.
+    q = q_ref[0]                                        # (BLOCK_Q, D)
     dim = q.shape[-1]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0
@@ -52,12 +56,12 @@ def _fwd_kernel(
 
     def body(kb, carry):
         o, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                               # (BLOCK_Q, BLOCK_K)
+        ) * scale                                       # (BLOCK_Q, BLOCK_K)
         if causal:
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1
@@ -71,7 +75,7 @@ def _fwd_kernel(
         correction = jnp.exp(m - m_new)
         l_new = l * correction + p.sum(axis=-1, keepdims=True)
         o_new = o * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return o_new, m_new, l_new
@@ -157,24 +161,34 @@ def _flash_bwd(causal, scale, residuals, g):
     jnp — XLA fuses the whole thing; the O(L^2) intermediate lives only
     inside the fused computation."""
     q, k, v, out, lse = residuals
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
+    # matmul operands in the input dtype (MXU full rate), f32 accumulate;
+    # softmax/correction math in f32
+    g = g.astype(q.dtype)
     logits = jnp.einsum(
-        "bqd,bkd->bqk", qf, kf, preferred_element_type=jnp.float32
+        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
         q_len, k_len = q.shape[1], k.shape[1]
         mask = jnp.arange(q_len)[:, None] >= jnp.arange(k_len)[None, :]
         logits = jnp.where(mask[None], logits, _NEG_INF)
     p = jnp.exp(logits - lse[..., None])                 # softmax probs
-    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
-    dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
-    delta = (gf * out.astype(jnp.float32)).sum(-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    pc = p.astype(q.dtype)
+    dv = jnp.einsum(
+        "bqk,bqd->bkd", pc, g, preferred_element_type=jnp.float32
+    )
+    dp = jnp.einsum(
+        "bqd,bkd->bqk", g, v, preferred_element_type=jnp.float32
+    )
+    delta = (
+        g.astype(jnp.float32) * out.astype(jnp.float32)
+    ).sum(-1, keepdims=True)
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq = jnp.einsum(
+        "bqk,bkd->bqd", ds, k, preferred_element_type=jnp.float32
+    )
+    dk = jnp.einsum(
+        "bqk,bqd->bkd", ds, q, preferred_element_type=jnp.float32
+    )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -192,6 +206,17 @@ def flash_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    from elasticdl_tpu.parallel.mesh import in_export_mode
+
+    if in_export_mode():
+        # Serving export: Pallas custom calls don't stage through jax2tf;
+        # the O(L^2) lax reference computes the same function.  Lazy
+        # import — ring_attention imports this module.
+        from elasticdl_tpu.ops.ring_attention import (
+            full_attention_reference,
+        )
+
+        return full_attention_reference(q, k, v, causal=causal, scale=scale)
     batch, q_len, heads, dim = q.shape
     k_len = k.shape[1]
 

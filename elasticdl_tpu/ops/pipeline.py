@@ -134,7 +134,12 @@ def gpipe_spmd(
     """
     stages = mesh.shape[pipe_axis]
     num_layers = jax.tree.leaves(stacked_params)[0].shape[0]
-    if stages == 1:
+    from elasticdl_tpu.parallel.mesh import in_export_mode
+
+    if stages == 1 or in_export_mode():
+        # pipe=1 — or serving export, where shard_map cannot stage
+        # through jax2tf: the sequential scan is the same computation on
+        # the same stacked param tree.
         return _sequential(apply_fn, stacked_params, x)
     if num_layers % stages:
         raise ValueError(

@@ -94,6 +94,12 @@ def ring_self_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    from elasticdl_tpu.parallel.mesh import in_export_mode
+
+    if in_export_mode():
+        # Serving export: jax2tf cannot stage shard_map/Pallas; the plain
+        # lax formulation is numerically the same computation.
+        return full_attention_reference(q, k, v, causal=causal, scale=scale)
     ring_size = mesh.shape[seq_axis]
     spec = P(data_axis, seq_axis, None, None)
     if ring_size == 1:

@@ -34,17 +34,57 @@ PIPE_AXIS = "pipe"
 # Trace-time mesh context: model code (e.g. ring attention inside a Flax
 # module) needs the mesh for shard_map, but zoo `custom_model()` factories
 # are mesh-agnostic.  The Trainer sets this before tracing/executing steps.
-_CURRENT_MESH: "Optional[Mesh]" = None
+# THREAD-local: a background prewarm compile (Trainer.prewarm_*) traces
+# under a different mesh concurrently with the training thread.
+import contextlib as _contextlib
+import threading as _threading
+
+_MESH_TLS = _threading.local()
+_DEFAULT_MESH: "Optional[Mesh]" = None
 
 
 def set_current_mesh(mesh: "Mesh") -> None:
-    global _CURRENT_MESH
-    _CURRENT_MESH = mesh
+    global _DEFAULT_MESH
+    _MESH_TLS.mesh = mesh
+    # also serves as the cross-thread default: helper threads that never
+    # set a mesh (data loaders calling feed etc.) see the training mesh
+    _DEFAULT_MESH = mesh
+
+
+# Export mode: serving export (jax2tf -> TF SavedModel) cannot stage
+# shard_map or Pallas custom calls.  Inside this context, mesh-manual ops
+# (ring attention, GPipe schedule, flash kernel) switch to their
+# numerically-identical single-device lax formulations — the param tree is
+# unchanged by design, so a checkpoint trained on any mesh exports.
+_EXPORT_MODE = _threading.local()
+
+
+@_contextlib.contextmanager
+def export_mode():
+    prev = getattr(_EXPORT_MODE, "on", False)
+    _EXPORT_MODE.on = True
+    try:
+        yield
+    finally:
+        _EXPORT_MODE.on = prev
+
+
+def in_export_mode() -> bool:
+    return getattr(_EXPORT_MODE, "on", False)
+
+
+def set_thread_mesh(mesh: "Mesh") -> None:
+    """Thread-local ONLY (no cross-thread default update): for background
+    work — prewarm compiles — that must not leak its mesh to others."""
+    _MESH_TLS.mesh = mesh
 
 
 def get_current_mesh() -> "Mesh":
-    if _CURRENT_MESH is not None:
-        return _CURRENT_MESH
+    mesh = getattr(_MESH_TLS, "mesh", None)
+    if mesh is not None:
+        return mesh
+    if _DEFAULT_MESH is not None:
+        return _DEFAULT_MESH
     return create_mesh()
 
 

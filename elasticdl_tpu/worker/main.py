@@ -203,18 +203,42 @@ def main(argv=None):
                 else ""
             ),
         )
+    drain_fn = (
+        worker.save_checkpoint_and_flush
+        if hasattr(worker, "save_checkpoint_and_flush")
+        else worker.model_owner.save_and_flush
+    )
     if saver_factory is not None:
         # Preemptible VMs: SIGTERM arrives with a grace window — flush one
         # final synchronous checkpoint so the next topology restores from
         # the last step, not the last periodic save (SURVEY.md §5).
         from elasticdl_tpu.common.preemption import install_preemption_hook
 
-        save_fn = (
-            worker.save_checkpoint_and_flush
-            if hasattr(worker, "save_checkpoint_and_flush")
-            else worker.model_owner.save_and_flush
+        install_preemption_hook(drain_fn)
+    notice_source = getattr(args, "preemption_notice_file", "")
+    if notice_source:
+        # Maintenance-event awareness (SURVEY §7 C4 mapping): act on the
+        # NOTICE — drain at a task boundary and checkpoint while the
+        # grace window is still all ours — instead of racing the kill.
+        from elasticdl_tpu.common.preemption import (
+            MaintenanceNoticeWatcher,
+            any_notice_checker,
+            file_notice_checker,
+            gce_metadata_checker,
         )
-        install_preemption_hook(save_fn)
+
+        checker = (
+            any_notice_checker(
+                gce_metadata_checker("preempted"),
+                gce_metadata_checker("maintenance-event"),
+            )
+            if notice_source == "gce-metadata"
+            else file_notice_checker(notice_source)
+        )
+        # The notice hook only SETS the drain flag; the main thread
+        # checkpoints at its next task boundary (a save from the watcher
+        # thread would race the training loop's state mutation).
+        MaintenanceNoticeWatcher(checker, worker.drain_and_stop).start()
 
     ok = worker.run()
     logger.info("Worker %d exiting (clean=%s)", worker_id, ok)

@@ -72,6 +72,7 @@ def report_evaluation_with_samples(
             pred_width=width,
             samples_only=not first,
             eval_task_key=task_id + 1 if task_id >= 0 else 0,
+            final_chunk=j >= len(labels),
         )
         if first:
             req.num_examples = num_examples
@@ -187,10 +188,25 @@ class Worker:
 
     # ---- loops ---------------------------------------------------------
 
+    def drain_and_stop(self) -> None:
+        """Maintenance-notice hook (thread-safe): request a stop at the
+        next task boundary.  The MAIN thread does the final checkpoint
+        there — saving from the watcher thread would race the training
+        loop's state mutation."""
+        self._stop_requested = True
+
     def run(self) -> bool:
         """Main loop until the master declares the job finished.  Returns
         True on clean completion."""
         while True:
+            if getattr(self, "_stop_requested", False):
+                logger.info(
+                    "Worker %d draining at task boundary "
+                    "(maintenance/preemption notice); flushing checkpoint",
+                    self.worker_id,
+                )
+                self._owner.save_and_flush()
+                return False
             task, finished = self._data_service.get_task()
             if finished:
                 logger.info("Job finished; worker %d exiting", self.worker_id)

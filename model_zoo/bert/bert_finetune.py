@@ -39,19 +39,20 @@ VOCAB_SIZE = 8192
 class RingSelfAttention(nn.Module):
     hidden: int
     heads: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         batch, length, _ = x.shape
         head_dim = self.hidden // self.heads
-        qkv = nn.Dense(3 * self.hidden, name="qkv")(x)
+        qkv = nn.Dense(3 * self.hidden, name="qkv", dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, length, self.heads, head_dim)
         out = ring_self_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             mesh=get_current_mesh(), causal=False,
         )
-        return nn.Dense(self.hidden, name="out")(
+        return nn.Dense(self.hidden, name="out", dtype=self.dtype)(
             out.reshape(batch, length, self.hidden)
         )
 
@@ -64,6 +65,7 @@ class LocalSelfAttention(nn.Module):
 
     hidden: int
     heads: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -72,7 +74,7 @@ class LocalSelfAttention(nn.Module):
 
         batch, length, _ = x.shape
         head_dim = self.hidden // self.heads
-        qkv = nn.Dense(3 * self.hidden, name="qkv")(x)
+        qkv = nn.Dense(3 * self.hidden, name="qkv", dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, length, self.heads, head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
@@ -80,7 +82,7 @@ class LocalSelfAttention(nn.Module):
             out = flash_attention(q, k, v, causal=False)
         except ValueError:  # un-tileable shape (trace-time check)
             out = full_attention_reference(q, k, v, causal=False)
-        return nn.Dense(self.hidden, name="out")(
+        return nn.Dense(self.hidden, name="out", dtype=self.dtype)(
             out.reshape(batch, length, self.hidden)
         )
 
@@ -93,15 +95,18 @@ class PipelinedBlock(nn.Module):
     hidden: int
     heads: int
     mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        y = LocalSelfAttention(self.hidden, self.heads, name="attention")(x)
-        x = nn.LayerNorm()(x + y)
-        y = nn.Dense(self.mlp_dim)(x)
+        y = LocalSelfAttention(
+            self.hidden, self.heads, dtype=self.dtype, name="attention"
+        )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x + y)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
         y = nn.gelu(y)
-        y = nn.Dense(self.hidden)(y)
-        return nn.LayerNorm()(x + y)
+        y = nn.Dense(self.hidden, dtype=self.dtype)(y)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
 
 
 class TransformerBlock(nn.Module):
@@ -112,11 +117,14 @@ class TransformerBlock(nn.Module):
     # experts, sharded over the mesh `expert` axis (expert parallelism —
     # capability the reference does not have)
     moe_experts: int = 0
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        y = RingSelfAttention(self.hidden, self.heads, name="attention")(x)
-        x = nn.LayerNorm()(x + y)
+        y = RingSelfAttention(
+            self.hidden, self.heads, dtype=self.dtype, name="attention"
+        )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x + y)
         if self.moe_experts > 0:
             from elasticdl_tpu.layers.moe import MoEMLP
 
@@ -125,10 +133,10 @@ class TransformerBlock(nn.Module):
                 name="moe_mlp",
             )(x)
         else:
-            y = nn.Dense(self.mlp_dim)(x)
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
             y = nn.gelu(y)
-            y = nn.Dense(self.hidden)(y)
-        return nn.LayerNorm()(x + y)
+            y = nn.Dense(self.hidden, dtype=self.dtype)(y)
+        return nn.LayerNorm(dtype=self.dtype)(x + y)
 
 
 class BertClassifier(nn.Module):
@@ -145,6 +153,12 @@ class BertClassifier(nn.Module):
     # capability the reference does not have).  Mutually exclusive with
     # moe_experts (the pipelined block is local-attention + dense FFN).
     pipeline_microbatches: int = 0
+    # bf16 matmuls run the MXU at full rate (4x the f32 rate on v5e);
+    # params stay f32 (flax param_dtype default).  LayerNorms compute in
+    # the same dtype (halves their HBM traffic — the step is partly
+    # bound by normalization/residual bandwidth); the embedding-input LN
+    # and the classifier head stay f32.
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, features):
@@ -172,7 +186,7 @@ class BertClassifier(nn.Module):
                 block_cls=PipelinedBlock,
                 block_kwargs={
                     "hidden": self.hidden, "heads": self.heads,
-                    "mlp_dim": self.mlp_dim,
+                    "mlp_dim": self.mlp_dim, "dtype": self.dtype,
                 },
                 num_layers=self.num_layers,
                 num_microbatches=self.pipeline_microbatches,
@@ -182,7 +196,8 @@ class BertClassifier(nn.Module):
             for i in range(self.num_layers):
                 x = TransformerBlock(
                     self.hidden, self.heads, self.mlp_dim,
-                    moe_experts=self.moe_experts, name=f"layer_{i}",
+                    moe_experts=self.moe_experts, dtype=self.dtype,
+                    name=f"layer_{i}",
                 )(x)
         # max-pool over sequence: sharp feature detection, and ring-
         # friendly (a cross-shard reduce, no CLS gather from one shard)
@@ -194,10 +209,11 @@ class BertClassifier(nn.Module):
 def custom_model(hidden: int = 768, num_layers: int = 12, heads: int = 12,
                  mlp_dim: int = 3072, max_len: int = MAX_LEN,
                  vocab_size: int = VOCAB_SIZE, moe_experts: int = 0,
-                 pipeline_microbatches: int = 0):
+                 pipeline_microbatches: int = 0, bf16: bool = False):
     return BertClassifier(
         vocab_size=vocab_size, hidden=hidden, num_layers=num_layers,
         heads=heads, mlp_dim=mlp_dim, max_len=max_len,
+        dtype=jnp.bfloat16 if bf16 else jnp.float32,
         moe_experts=moe_experts,
         pipeline_microbatches=pipeline_microbatches,
     )

@@ -1,0 +1,222 @@
+"""Record fixed-seed convergence trajectories for every zoo config.
+
+Produces the docs/CONVERGENCE.md table (SURVEY §7 hard part 4: the
+reference's async-PS staleness semantics are gone — bulk-synchronous SPMD
+convergence must be re-baselined by measurement, not assumed).  Every run
+is deterministic: fixed data seed, fixed init seed, fixed batch order.
+tests/test_convergence.py re-runs the DeepFM and MNIST rows and asserts
+the recorded metrics have not regressed.
+
+Usage:  python scripts/record_convergence.py [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "model_zoo"))
+
+
+def _trainer(model_def, model_params=""):
+    import jax
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    spec = get_model_spec(
+        os.path.join(_ROOT, "model_zoo"), model_def,
+        model_params=model_params,
+    )
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+        param_sharding_fn=spec.param_sharding,
+    )
+    return spec, trainer, jax
+
+
+def _run(spec, trainer, jax, batches, eval_batch, metric_fn,
+         checkpoints):
+    """Train over `batches`; at each checkpoint step record the metric on
+    `eval_batch`.  Returns {step: metric}."""
+    state = trainer.init_state(
+        jax.random.PRNGKey(0), batches[0]["features"]
+    )
+    out = {}
+    for i, batch in enumerate(batches, start=1):
+        state, _ = trainer.train_on_batch(state, batch)
+        if i in checkpoints:
+            preds = trainer.predict_on_batch(
+                state, eval_batch["features"]
+            )
+            out[i] = round(float(metric_fn(eval_batch["labels"], preds)), 4)
+    return out
+
+
+def deepfm():
+    from model_zoo.common.metrics import auc
+    from model_zoo.deepfm.data import synthetic_criteo
+
+    spec, trainer, jax = _trainer(
+        "deepfm.deepfm_functional_api.custom_model",
+        "vocab_capacity=262144;embed_dim=16;lr=0.005",
+    )
+    bs, steps = 4096, 64
+    dense, sparse, labels = synthetic_criteo(bs * steps, seed=0)
+    batches = [
+        {
+            "features": {
+                "dense": dense[i * bs:(i + 1) * bs],
+                "sparse": sparse[i * bs:(i + 1) * bs],
+            },
+            "labels": labels[i * bs:(i + 1) * bs].astype(np.int32),
+        }
+        for i in range(steps)
+    ]
+    vd, vs, vy = synthetic_criteo(16384, seed=1000)
+    eval_batch = {"features": {"dense": vd, "sparse": vs}, "labels": vy}
+    return "DeepFM / synthetic Criteo", "auc", _run(
+        spec, trainer, jax, batches, eval_batch, auc, {16, 32, 64}
+    )
+
+
+def mnist():
+    from model_zoo.mnist.data import synthetic_mnist
+
+    spec, trainer, jax = _trainer("mnist.mnist_functional_api.custom_model")
+    bs, steps = 128, 60
+    xs, ys = synthetic_mnist(bs * steps, seed=0)
+    feed = spec.feed
+    batches = [
+        feed([
+            xs[i].tobytes() + bytes([int(ys[i])])
+            for i in range(j * bs, (j + 1) * bs)
+        ])
+        for j in range(steps)
+    ]
+    xv, yv = synthetic_mnist(1024, seed=77)
+    eval_batch = feed(
+        [xv[i].tobytes() + bytes([int(yv[i])]) for i in range(1024)]
+    )
+
+    def acc(labels, preds):
+        return float(np.mean(np.argmax(preds, -1) == labels))
+
+    return "MNIST CNN / synthetic", "accuracy", _run(
+        spec, trainer, jax, batches, eval_batch, acc, {15, 30, 60}
+    )
+
+
+def census():
+    from model_zoo.census.data import synthetic_census
+    from model_zoo.census.wide_and_deep import COLUMNS
+    from model_zoo.common.metrics import auc
+
+    spec, trainer, jax = _trainer(
+        "census.wide_and_deep.custom_model", "lr=0.005"
+    )
+    bs, epochs = 512, 4
+    n = 8192
+    rows = synthetic_census(n + 4096, seed=0)
+    per_epoch = n // bs
+    batches = [
+        spec.feed(rows[j * bs:(j + 1) * bs])
+        for _ in range(epochs)
+        for j in range(per_epoch)
+    ]
+    eval_batch = spec.feed(rows[n:])
+    steps = per_epoch * epochs  # 64
+    return "Wide&Deep / synthetic census (4 epochs)", "auc", _run(
+        spec, trainer, jax, batches, eval_batch, auc,
+        {per_epoch, per_epoch * 2, steps},
+    )
+
+
+def cifar10():
+    from model_zoo.cifar10.data import synthetic_cifar
+
+    spec, trainer, jax = _trainer("cifar10.resnet.custom_model")
+    bs, steps = 64, 16
+    xs, ys = synthetic_cifar(bs * steps, seed=0)
+    recs = [
+        xs[i].tobytes() + bytes([int(ys[i])]) for i in range(bs * steps)
+    ]
+    batches = [
+        spec.feed(recs[j * bs:(j + 1) * bs]) for j in range(steps)
+    ]
+    xv, yv = synthetic_cifar(512, seed=9)
+    eval_batch = spec.feed(
+        [xv[i].tobytes() + bytes([int(yv[i])]) for i in range(512)]
+    )
+
+    def acc(labels, preds):
+        return float(np.mean(np.argmax(preds, -1) == labels))
+
+    return "ResNet-50 / synthetic CIFAR", "accuracy", _run(
+        spec, trainer, jax, batches, eval_batch, acc, {8, 16}
+    )
+
+
+def bert():
+    from model_zoo.bert.data import synthetic_pairs
+
+    spec, trainer, jax = _trainer(
+        "bert.bert_finetune.custom_model",
+        "hidden=64;num_layers=2;heads=4;mlp_dim=128;max_len=32;"
+        "vocab_size=16;lr=0.003",
+    )
+    # the planted long-range compare needs a few hundred steps (matches
+    # tests/test_bert.py: 6 epochs x 4096 examples at batch 64)
+    bs, steps = 64, 384
+    epoch = bs * 64
+    ids, labels = synthetic_pairs(epoch, max_len=32, vocab=16, seed=0)
+    ids = np.concatenate([ids] * 6)
+    labels = np.concatenate([labels] * 6)
+    batches = [
+        {
+            "features": {"input_ids": ids[j * bs:(j + 1) * bs]},
+            "labels": labels[j * bs:(j + 1) * bs].astype(np.int32),
+        }
+        for j in range(steps)
+    ]
+    iv, lv = synthetic_pairs(1024, max_len=32, vocab=16, seed=9)
+    eval_batch = {
+        "features": {"input_ids": iv}, "labels": lv.astype(np.int32)
+    }
+
+    def acc(labels, preds):
+        return float(np.mean(np.argmax(preds, -1) == labels))
+
+    return "BERT / planted long-range pairs (6 epochs)", "accuracy", _run(
+        spec, trainer, jax, batches, eval_batch, acc, {128, 256, 384}
+    )
+
+
+def main():
+    results = []
+    for fn in (deepfm, mnist, census, cifar10, bert):
+        name, metric, curve = fn()
+        results.append({"config": name, "metric": metric, "curve": curve})
+        print(f"{name}: {metric} @ steps {curve}", file=sys.stderr)
+    if "--json" in sys.argv:
+        print(json.dumps(results, indent=2))
+    else:
+        print("| config | metric | " + " | ".join(
+            f"step {s}" for s in sorted(results[0]["curve"])
+        ) + " |")
+        for r in results:
+            steps = sorted(r["curve"])
+            print(
+                f"| {r['config']} | {r['metric']} | "
+                + " | ".join(str(r["curve"][s]) for s in steps) + " |"
+            )
+    return results
+
+
+if __name__ == "__main__":
+    main()

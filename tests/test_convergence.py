@@ -1,0 +1,38 @@
+"""Convergence regression pins (docs/CONVERGENCE.md): the DeepFM and
+MNIST fixed-seed trajectories must not regress.  SURVEY §7 hard part 4 —
+bulk-synchronous SPMD replaced the reference's async-PS semantics, so
+convergence is baselined by measurement; these tests keep the baseline
+honest at suite speed (the full 5-config table is regenerated with
+scripts/record_convergence.py)."""
+
+import runpy
+
+import pytest
+
+_MOD = runpy.run_path("scripts/record_convergence.py")
+
+# recorded in docs/CONVERGENCE.md (round 4); margin covers cross-platform
+# float noise, not regressions
+MARGIN = 0.01
+
+
+def test_deepfm_trajectory_not_regressed():
+    name, metric, curve = _MOD["deepfm"]()
+    assert metric == "auc"
+    recorded = {16: 0.7894, 32: 0.8071, 64: 0.8224}
+    for step, value in recorded.items():
+        assert curve[step] >= value - MARGIN, (
+            f"DeepFM AUC regressed at step {step}: "
+            f"{curve[step]} < {value} (recorded) - {MARGIN}"
+        )
+
+
+def test_mnist_trajectory_not_regressed():
+    name, metric, curve = _MOD["mnist"]()
+    assert metric == "accuracy"
+    recorded = {15: 1.0, 30: 1.0, 60: 1.0}
+    for step, value in recorded.items():
+        assert curve[step] >= value - MARGIN, (
+            f"MNIST accuracy regressed at step {step}: "
+            f"{curve[step]} < {value} (recorded) - {MARGIN}"
+        )
