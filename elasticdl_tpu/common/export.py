@@ -54,6 +54,15 @@ def export_model(
         "model_class": type(spec.model).__name__,
         "framework": "elasticdl-tpu",
     }
+
+    def write_meta():
+        with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+
+    # meta is ALWAYS written (module contract) — before the SavedModel
+    # attempt, so a raise/crash mid-export still leaves a loadable
+    # msgpack + meta pair; re-written below with the SavedModel outcome.
+    write_meta()
     if saved_model:
         if sample_features is None:
             # raise so export_for_task re-queues to a worker that HAS
@@ -90,8 +99,7 @@ def export_model(
                 "SavedModel export failed (%s); wrote params.msgpack "
                 "only", exc,
             )
-    with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
+        write_meta()
     return path
 
 

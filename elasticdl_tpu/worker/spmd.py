@@ -262,32 +262,36 @@ class SPMDWorker:
         if self.num_processes <= 1 or getattr(self, "_prewarmed", False):
             return
         self._prewarmed = True
-        per = max(len(jax.devices()) // self.num_processes, 1)
-        counts = sorted(
-            {
-                (self.num_processes - 1) * per,
-                (self.num_processes // 2) * per,
-            }
-            - {0, len(jax.devices())}
-        )
-        if not counts:
-            return
-        rows = global_rows or self.minibatch_size
-        sample = {
-            "features": jax.tree.map(
-                lambda a: np.zeros(
-                    (rows,) + np.asarray(a).shape[1:], np.asarray(a).dtype
+        try:
+            per = max(len(jax.devices()) // self.num_processes, 1)
+            counts = sorted(
+                {
+                    (self.num_processes - 1) * per,
+                    (self.num_processes // 2) * per,
+                }
+                - {0, len(jax.devices())}
+            )
+            if not counts or "labels" not in batch:
+                # prediction-only feeds carry no labels; the train step
+                # (the thing worth prewarming) is not on their path
+                return
+            rows = global_rows or self.minibatch_size
+
+            def zeros_like_rows(a):
+                a = np.asarray(a)
+                return np.zeros((rows,) + a.shape[1:], a.dtype)
+
+            sample = {
+                "features": jax.tree.map(
+                    zeros_like_rows, batch["features"]
                 ),
-                batch["features"],
-            ),
-            "labels": np.zeros(
-                (rows,) + np.asarray(batch["labels"]).shape[1:],
-                np.asarray(batch["labels"]).dtype,
-            ),
-        }
-        self.trainer.prewarm_for_device_counts(
-            sample, counts, rng=jax.random.PRNGKey(self._seed)
-        )
+                "labels": zeros_like_rows(batch["labels"]),
+            }
+            self.trainer.prewarm_for_device_counts(
+                sample, counts, rng=jax.random.PRNGKey(self._seed)
+            )
+        except Exception:  # advisory path: never fail the task for it
+            logger.exception("elastic prewarm setup skipped")
 
     @property
     def is_leader(self) -> bool:

@@ -367,8 +367,22 @@ class Trainer:
         process for the cache key to match — true for pod relaunches,
         which re-serialize the same argv/env.
         """
+        import os
         import threading
 
+        if not block and (os.cpu_count() or 1) < 4:
+            # A background XLA compile on a starved host (1-2 cores —
+            # CI boxes) competes with the training loop for the SAME
+            # cores and can stall it past the wedge-watchdog grace
+            # (observed in the cluster drills: a 25s prewarm compile got
+            # the rank shot as wedged).  Real TPU hosts have 100+ vCPUs;
+            # skip only where the background work would do net harm.
+            logger.info(
+                "prewarm skipped: %s cores is too few to compile in the "
+                "background without starving the training loop",
+                os.cpu_count(),
+            )
+            return None
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         features = jax.tree.map(np.asarray, sample_batch["features"])
 
@@ -402,7 +416,6 @@ class Trainer:
             param_sharding_fn=self._param_sharding_fn,
         )
         prev_mesh = mesh_lib.get_current_mesh()
-        mesh_lib.set_thread_mesh(mesh)
         kwargs = {"train": False} if self._has_train_kwarg else {}
 
         def make():
@@ -417,23 +430,29 @@ class Trainer:
                 model_state=variables,
             )
 
-        shapes = jax.eval_shape(make)
-        shardings = warm.state_sharding(shapes)
-        abstract_state = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            shapes, shardings,
-        )
-        abstract_batch = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(
-                np.asarray(a).shape, np.asarray(a).dtype,
-                sharding=warm._data,
-            ),
-            sample_batch,
-        )
+        # everything tracing under the prewarm mesh sits inside the
+        # try/finally: a failure anywhere (eval_shape, sharding, lower)
+        # must not leak the small mesh into the caller thread's TLS
+        # (block=True runs on the caller's thread)
+        mesh_lib.set_thread_mesh(mesh)
         try:
+            shapes = jax.eval_shape(make)
+            shardings = warm.state_sharding(shapes)
+            abstract_state = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                shapes, shardings,
+            )
+            abstract_batch = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    np.asarray(a).shape, np.asarray(a).dtype,
+                    sharding=warm._data,
+                ),
+                sample_batch,
+            )
             warm.train_step.lower(abstract_state, abstract_batch).compile()
         finally:
-            # restore the caller thread's mesh (block=True runs here)
             mesh_lib.set_thread_mesh(prev_mesh)
         logger.info(
             "prewarmed train step for %d-device mesh in %.1fs (persistent"

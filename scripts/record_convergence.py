@@ -206,14 +206,16 @@ def main():
     if "--json" in sys.argv:
         print(json.dumps(results, indent=2))
     else:
-        print("| config | metric | " + " | ".join(
-            f"step {s}" for s in sorted(results[0]["curve"])
-        ) + " |")
+        # per-row checkpoint steps differ by config, so each row labels
+        # its own values (a shared step header would misattribute them)
+        print("| config | metric | checkpoint steps | values |")
+        print("|---|---|---|---|")
         for r in results:
             steps = sorted(r["curve"])
             print(
                 f"| {r['config']} | {r['metric']} | "
-                + " | ".join(str(r["curve"][s]) for s in steps) + " |"
+                + " / ".join(str(s) for s in steps) + " | "
+                + " / ".join(str(r["curve"][s]) for s in steps) + " |"
             )
     return results
 

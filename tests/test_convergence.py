@@ -5,11 +5,15 @@ convergence is baselined by measurement; these tests keep the baseline
 honest at suite speed (the full 5-config table is regenerated with
 scripts/record_convergence.py)."""
 
+import os
 import runpy
 
-import pytest
-
-_MOD = runpy.run_path("scripts/record_convergence.py")
+_MOD = runpy.run_path(
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "record_convergence.py",
+    )
+)
 
 # recorded in docs/CONVERGENCE.md (round 4); margin covers cross-platform
 # float noise, not regressions
