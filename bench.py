@@ -79,10 +79,9 @@ def _make_criteo_batch(batch_size: int):
         "features": {
             "dense": rng.rand(batch_size, 13).astype(np.float32),
             # zipf-distributed ids over a large raw space: real CTR
-            # traffic is heavily skewed (which the embedding backward's
-            # duplicate-collapsing scatter exploits) but large fields have
-            # millions of distinct values — a small modulus would make the
-            # table trivially cache-resident and flatter the bench
+            # traffic is heavily skewed, but large fields have millions
+            # of distinct values — a small modulus would make the table
+            # trivially cache-resident and flatter the bench
             "sparse": (
                 rng.zipf(1.5, size=(batch_size, 26)) % (1 << 22)
             ).astype(np.int32),
@@ -214,8 +213,8 @@ def bench_deepfm(iters: int = 30):
         detail["mfu"] = round(flops * steps_per_sec / peaks["bf16_flops"], 4)
 
     # Embedding fwd+bwd probe, isolated and device-honest (fused loop,
-    # scalar out): the design-note evidence for the duplicate-collapsing
-    # lookup backward vs SparseCore (SURVEY.md §7 hard part 2).
+    # scalar out): the design-note evidence for the XLA gather/scatter
+    # path vs SparseCore (SURVEY.md §7 hard part 2).
     import time as _time
 
     from elasticdl_tpu.layers.embedding import _lookup

@@ -38,24 +38,23 @@ _PIB = lax.GatherScatterMode.PROMISE_IN_BOUNDS
 
 @jax.custom_vjp
 def _lookup(table, flat_ids):
-    """Gather rows with a duplicate-collapsing backward.
+    """Gather rows; backward is XLA's plain scatter-add.
 
-    Measured on TPU v5e (1M x 16 table, 852K zipf ids/step — the DeepFM
-    north-star shape): the naive path spends ~80ms/step in the embedding
-    ops (23ms gather + 58ms scatter-add with duplicate indices, which the
-    TPU serializes per-op); this path runs the same math in ~18ms:
+    The custom part that remains is the FORWARD: ids are hashed mod
+    capacity by construction, so the gather's bounds branch is provably
+    dead — PROMISE_IN_BOUNDS makes that explicit.
 
-    - forward: gather with PROMISE_IN_BOUNDS (ids are hashed mod capacity
-      by construction, so the bounds branch is provably dead) — 23 -> 8ms;
-    - backward: sort ids, permute grads, collapse duplicate-id runs with a
-      log2(N)-pass segmented suffix scan (2.7ms), then scatter-add ONLY
-      each run's head row — non-heads are sent out of bounds and dropped,
-      so scatter traffic is proportional to UNIQUE ids (zipf CTR traffic:
-      ~13K of 852K) — 58 -> ~9ms.
-
-    CTR id skew is exactly what makes the naive scatter pathological and
-    this one fast; uniform ids degrade gracefully (scan passes are cheap,
-    scatter approaches the naive cost).
+    History (round-4 re-measurement, docs/embedding_design_note.md):
+    rounds 2-3 shipped a duplicate-collapsing backward here (sort +
+    log2(N)-pass segmented suffix scan + head-only scatter) on probes
+    suggesting the scatter's cost scaled with duplicate destinations.
+    Carried-table probes — the only scatter timing that survives XLA's
+    partial-consumption elision — show otherwise on this stack: a raw
+    1.7M x 16 scatter-add costs ~123 ms whether ids are unique, zipf, or
+    mostly dropped, so the collapse machinery's ~26 ms of sort/scan was
+    pure overhead (149 ms vs 129 ms for the plain VJP, full fwd+bwd).
+    Keep the simple thing; the scatter itself (~14M random rows/s) is
+    the ceiling SparseCore would lift.
     """
     return table.at[flat_ids].get(mode=_PIB)
 
@@ -68,32 +67,10 @@ def _lookup_fwd(table, flat_ids):
 
 def _lookup_bwd(residuals, g):
     table, flat_ids = residuals
-    shape, dtype = table.shape, table.dtype
-    n = flat_ids.shape[0]
-    sid, perm = lax.sort_key_val(
-        flat_ids, jnp.arange(n, dtype=jnp.int32)
+    dtable = (
+        jnp.zeros(table.shape, g.dtype).at[flat_ids].add(g, mode=_PIB)
     )
-    gs = g.at[perm].get(mode=_PIB)            # grads ordered by id
-    # segmented suffix scan (Hillis-Steele): after pass k, gs[i] covers
-    # rows [i, i + 2^(k+1)) of its run; log2(n) passes leave each run's
-    # HEAD holding the run's full sum
-    span = 1
-    while span < n:
-        same = jnp.concatenate(
-            [sid[:-span] == sid[span:], jnp.zeros((span,), bool)]
-        )
-        shifted = jnp.concatenate(
-            [gs[span:], jnp.zeros((span,) + gs.shape[1:], gs.dtype)]
-        )
-        gs = gs + jnp.where(same[:, None], shifted, 0.0)
-        span <<= 1
-    head = jnp.concatenate(
-        [jnp.ones((1,), bool), sid[1:] != sid[:-1]]
-    )
-    # non-heads point out of bounds and are DROPPED: writes ~ unique ids
-    sentinel = jnp.where(head, sid, jnp.int32(shape[0]))
-    dtable = jnp.zeros(shape, g.dtype).at[sentinel].add(gs, mode="drop")
-    return dtable.astype(dtype), None
+    return dtable.astype(table.dtype), None
 
 
 _lookup.defvjp(_lookup_fwd, _lookup_bwd)

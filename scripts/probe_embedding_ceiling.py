@@ -74,7 +74,9 @@ def main():
             grad = jax.grad(lambda tt: (_lookup(tt, i) ** 2).sum())(
                 t + 0.0 * acc
             )
-            return grad[0, 0]
+            # consume the WHOLE gradient (warning 4: partial consumption
+            # of a scatter output can elide most of its work)
+            return grad.sum()
 
         dt_fb = timed(fwd_bwd_probe, table, ids, iters=12)
         results[f"fwd+bwd random {label}"] = dt_fb
@@ -91,6 +93,46 @@ def main():
     table16 = jnp.asarray(rng.rand(rows, 16).astype(np.float32))
     results["sort+gather+unpermute 16 f32"] = timed(
         sorted_fwd_probe, table16, ids
+    )
+
+    # Scatter probes with the TABLE AS THE CARRY — design-note warning 4:
+    # consuming only out[0,0] of a zero-initialized scatter lets XLA
+    # elide most of the work (reads ~16ms instead of the real ~123ms).
+    grads = jnp.asarray(rng.rand(n_ids, 16).astype(np.float32))
+
+    def timed_carry(fn, init, *args, iters=12):
+        def loop(init, *a):
+            def body(_, carry):
+                return fn(carry, *a)
+
+            return jax.lax.fori_loop(0, iters, body, init)[0, 0]
+
+        f = jax.jit(loop)
+        jax.device_get(f(init, *args))
+        t0 = time.perf_counter()
+        jax.device_get(f(init, *args))
+        return (time.perf_counter() - t0) / iters
+
+    from jax.lax import GatherScatterMode as _GSM
+
+    for mode, mlabel in [("drop", "drop"), (_GSM.PROMISE_IN_BOUNDS, "PIB")]:
+        results[f"scatter-add zipf carried [{mlabel}]"] = timed_carry(
+            lambda t, i, g, m=mode: t.at[i].add(g, mode=m),
+            table16, ids, grads,
+        )
+    # unique-vs-duplicate at EQUAL id counts (1M each; a 1.7M 'unique'
+    # set cannot exist in a 1M-row table)
+    m = rows
+    uniq_m = jnp.asarray(rng.permutation(rows).astype(np.int32))
+    zipf_m = ids[:m]
+    grads_m = grads[:m]
+    results["scatter-add 1M all-unique carried"] = timed_carry(
+        lambda t, i, g: t.at[i].add(g, mode=_GSM.PROMISE_IN_BOUNDS),
+        table16, uniq_m, grads_m,
+    )
+    results["scatter-add 1M zipf carried"] = timed_carry(
+        lambda t, i, g: t.at[i].add(g, mode=_GSM.PROMISE_IN_BOUNDS),
+        table16, zipf_m, grads_m,
     )
 
     for name, dt in results.items():
