@@ -155,14 +155,6 @@ def main(argv=None):
             worker_id, cluster.rendezvous_id, me.rank, cluster.world_size,
             my_addr, cluster.coordinator_address,
         )
-        if getattr(args, "steps_per_execution", 1) > 1:
-            # The SPMD collective step is dispatched per global batch;
-            # stack dispatch there needs global-array stacking, not yet
-            # wired.  Warn rather than silently ignore the flag.
-            logger.warning(
-                "--steps_per_execution > 1 applies to Local/single-"
-                "worker mode only; cluster SPMD ignores it"
-            )
         worker = SPMDWorker(
             worker_id=worker_id,
             master_client=client,
@@ -178,6 +170,7 @@ def main(argv=None):
             initial_epoch=cluster.rendezvous_id,
             output_dir=getattr(args, "output", ""),
             wedge_grace_s=args.wedge_grace_s,
+            steps_per_execution=getattr(args, "steps_per_execution", 1),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")

@@ -125,10 +125,15 @@ class SPMDWorker:
         output_dir: str = "",
         tensorboard_dir: str = "",
         profile_dir: str = "",
+        steps_per_execution: int = 1,
     ):
         self.worker_id = worker_id
         self.spec = spec
         self.minibatch_size = minibatch_size
+        # >1 dispatches that many collective train steps as one jitted
+        # scan over a global (K, B, ...) batch stack (deterministic
+        # grouping — identical on every rank)
+        self.steps_per_execution = max(1, int(steps_per_execution))
         self.process_id = process_id
         self.num_processes = num_processes
         self._coordinator = coordinator_address
@@ -506,6 +511,12 @@ class SPMDWorker:
                 self._feed_bulk, local[0], local[1],
             )
         else:  # non-contiguous local rows: every rank reads everything
+            if self.steps_per_execution > 1:
+                logger.warning(
+                    "steps_per_execution=%d ignored: this rank's rows of "
+                    "the data axis are not one contiguous range, so "
+                    "batches dispatch singly", self.steps_per_execution,
+                )
             batches = (
                 (batch, real, False)
                 for batch, real in self._data_service.batches_for_task(
@@ -513,18 +524,9 @@ class SPMDWorker:
                     feed_bulk=self._feed_bulk,
                 )
             )
-        for batch, real, is_local in batches:
-            self._ensure_state(batch, global_rows=self.minibatch_size)
-            if is_local:
-                global_batch = mesh_lib.make_global_batch_from_local(
-                    batch, self.mesh, self.minibatch_size, local[0]
-                )
-            else:
-                global_batch = mesh_lib.make_global_batch(batch, self.mesh)
-            self.state, loss = self.trainer.train_on_global_batch(
-                self.state, global_batch
-            )
-            self.last_loss = loss
+        from elasticdl_tpu.worker.task_data_service import prefetch_batches
+
+        def mark_recovered():
             if self._recovery_t0 is not None:
                 # BASELINE.md's headline elasticity metric: preemption
                 # (epoch bump observed) -> first post-restore optimizer
@@ -536,9 +538,67 @@ class SPMDWorker:
                     self.num_processes, int(self.state.step),
                 )
                 self._recovery_t0 = None
+
+        def single_step(one_batch, one_is_local):
+            if one_is_local:
+                gb = mesh_lib.make_global_batch_from_local(
+                    one_batch, self.mesh, self.minibatch_size, local[0]
+                )
+            else:
+                gb = mesh_lib.make_global_batch(one_batch, self.mesh)
+            self.state, loss = self.trainer.train_on_global_batch(
+                self.state, gb
+            )
+            self.last_loss = loss
+            mark_recovered()
             self.step_timer.tick()
-            records += real
             self._maybe_checkpoint()
+
+        # steps_per_execution grouping: full groups of slice-local
+        # batches dispatch as ONE scan program over a global (K, B, ...)
+        # stack; tails and non-local batches run single-step, so only
+        # two program shapes ever compile.  The decision is identical on
+        # every rank (same batch stream), keeping the collective in step.
+        # The first post-recovery batch always runs single-step so the
+        # recovery clock measures loss -> FIRST optimizer step, not
+        # loss -> K steps.
+        pending = []
+        # host read/parse overlaps the collective step (double buffering)
+        for batch, real, is_local in prefetch_batches(batches):
+            self._ensure_state(batch, global_rows=self.minibatch_size)
+            records += real
+            if (
+                is_local
+                and self.steps_per_execution > 1
+                and self._recovery_t0 is None
+            ):
+                pending.append(batch)
+                if len(pending) == self.steps_per_execution:
+                    stack = mesh_lib.make_global_batch_stack_from_local(
+                        pending, self.mesh, self.minibatch_size, local[0]
+                    )
+                    pending = []
+                    self.state, losses = (
+                        self.trainer.train_on_global_batch_stack(
+                            self.state, stack
+                        )
+                    )
+                    self.last_loss = losses[-1]
+                    mark_recovered()
+                    for _ in range(self.steps_per_execution):
+                        self.step_timer.tick()
+                    self._maybe_checkpoint(
+                        stride=self.steps_per_execution
+                    )
+                continue
+            # preserve data order: a wrap-padded (non-local) tail batch
+            # must not train before still-pending grouped batches
+            for held in pending:
+                single_step(held, True)
+            pending = []
+            single_step(batch, is_local)
+        for batch in pending:  # task tail: single-step program
+            single_step(batch, True)
         if self.last_loss is not None:
             self._summary.scalars(
                 {
@@ -805,11 +865,15 @@ class SPMDWorker:
         if self._saver is not None and self.state is not None:
             self._saver.save(self.state, force=force)
 
-    def _maybe_checkpoint(self) -> None:
+    def _maybe_checkpoint(self, stride: int = 1) -> None:
+        # crossing check (not exact modulo): a K-step scan dispatch may
+        # jump past a multiple of checkpoint_steps (worker/sync.py has
+        # the same rule).  Deterministic on step, so all ranks enter the
+        # collective save together.
         if (
             self._saver is not None
             and self._checkpoint_steps
-            and int(self.state.step) % self._checkpoint_steps == 0
+            and int(self.state.step) % self._checkpoint_steps < stride
         ):
             self._saver.save(self.state)
 

@@ -327,6 +327,15 @@ class Trainer:
             self.train_step_many, state, stacked
         )
 
+    def train_on_global_batch_stack(self, state, global_stacked):
+        """K-step scan on an already-assembled global (K, B, ...) stack
+        (mesh.make_global_batch_stack_from_local) — the multi-process
+        steps_per_execution hot path.  Returns (state, losses (K,))."""
+        mesh_lib.set_current_mesh(self.mesh)
+        return run_device_serialized(
+            self.train_step_many, state, global_stacked
+        )
+
     def train_on_global_batch(self, state, global_batch):
         """Train step on a batch already assembled into global arrays
         (mesh.make_global_batch) — the multi-process SPMD hot path."""

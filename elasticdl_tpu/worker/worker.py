@@ -298,12 +298,17 @@ class Worker:
         return self._train_task_inner(task)
 
     def _train_task_inner(self, task: pb.Task) -> int:
+        from elasticdl_tpu.worker.task_data_service import prefetch_batches
+
         records = 0
         loss = None
         pending = []
-        for batch, real in self._data_service.batches_for_task(
-            task, self.minibatch_size, self._feed,
-            feed_bulk=self._feed_bulk,
+        # host read/parse overlaps the device step (double buffering)
+        for batch, real in prefetch_batches(
+            self._data_service.batches_for_task(
+                task, self.minibatch_size, self._feed,
+                feed_bulk=self._feed_bulk,
+            )
         ):
             records += real
             if self.steps_per_execution > 1:

@@ -114,3 +114,52 @@ def test_worker_tail_uses_single_step(monkeypatch):
     assert calls["stack"] == [4]
     assert calls["single"] == 2
     assert int(owner.state.step) == 6
+
+
+def test_spmd_stack_matches_single_step_dispatch():
+    """Cluster-path steps_per_execution: K collective steps scanned over
+    a global (K, B, ...) stack must produce the same trajectory as K
+    single-step dispatches (single process over the 8-device mesh; the
+    multi-rank bitwise pin rides test_spmd/test_cluster_e2e)."""
+    import jax
+
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+
+    spec = get_model_spec(MODEL_ZOO, "mnist.mnist_functional_api.custom_model")
+    batches = _batches(k=4, batch=32)
+    mesh = mesh_lib.create_mesh()
+    lstart, lstop = mesh_lib.local_batch_range(mesh, 32)
+
+    def make_trainer():
+        return Trainer(
+            model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+            mesh=mesh,
+        )
+
+    t1 = make_trainer()
+    state_seq = t1.init_state_global(
+        jax.random.PRNGKey(0), batches[0]["features"]
+    )
+    for b in batches:
+        gb = mesh_lib.make_global_batch_from_local(b, mesh, 32, lstart)
+        state_seq, _ = t1.train_on_global_batch(state_seq, gb)
+
+    t2 = make_trainer()
+    state_stk = t2.init_state_global(
+        jax.random.PRNGKey(0), batches[0]["features"]
+    )
+    stack = mesh_lib.make_global_batch_stack_from_local(
+        batches, mesh, 32, lstart
+    )
+    state_stk, losses = t2.train_on_global_batch_stack(state_stk, stack)
+
+    assert int(state_stk.step) == int(state_seq.step) == 4
+    assert losses.shape == (4,)
+    # scan vs per-call fusion reassociates float adds; measured max
+    # divergence after 4 steps is ~3e-6 on these magnitudes
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        state_stk.params, state_seq.params,
+    )
