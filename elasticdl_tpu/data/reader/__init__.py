@@ -48,6 +48,12 @@ register_data_reader("csv", CSVDataReader)
 register_data_reader("tfrecord", TFRecordDataReader)
 register_data_reader("sqlite", TableDataReader)
 
+from elasticdl_tpu.data.reader.grain_reader import (  # noqa: E402,F401
+    GrainDataReader,
+)
+
+register_data_reader("grain", GrainDataReader)
+
 
 def create_data_reader(data_origin: str, **kwargs) -> AbstractDataReader:
     """Dispatch on origin:

@@ -26,6 +26,22 @@ def records(images, labels):
         yield img.tobytes() + bytes([int(lbl)])
 
 
+def grain_dataset(n: int = 2048, seed: int = 0):
+    """`grain://` factory example (see data/reader/grain_reader.py): a
+    random-access Grain MapDataset serving the same 785-byte records the
+    TFRecord pipeline does — submit with
+    --training_data 'grain://mnist.data:grain_dataset?n=2048'."""
+    import grain
+
+    images, labels = synthetic_mnist(n, seed)
+    return grain.MapDataset.source(
+        [
+            images[i].tobytes() + bytes([int(labels[i])])
+            for i in range(n)
+        ]
+    )
+
+
 def write_dataset(directory: str, n_train: int = 2048, n_val: int = 512,
                   seed: int = 0):
     os.makedirs(os.path.join(directory, "train"), exist_ok=True)
