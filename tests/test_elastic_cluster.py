@@ -439,7 +439,10 @@ def test_bert_under_induced_preemption(tmp_path):
     assert master.task_manager.counters.records_done >= 2 * 256
     history = master.recovery_clock.history
     assert history, "no recovery was measured"
-    assert max(history) < 60.0 * _cache_cold_factor(), (
+    # the kill wedges the surviving peer in a collective, so this drill
+    # takes the watchdog-grace + full-group-restart path (the 120s
+    # coordinator-loss budget), not the 60s fast path
+    assert max(history) < 120.0 * _cache_cold_factor(), (
         f"BERT preemption recovery blew the budget: {history}"
     )
     print(

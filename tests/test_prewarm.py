@@ -31,10 +31,27 @@ def _batch(n=64):
 
 
 def test_prewarm_populates_cache_and_matches_live_compile(tmp_path):
-    spec = get_model_spec(ZOO, "mnist.mnist_functional_api.custom_model")
-    trainer = Trainer(
-        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss
-    )
+    import flax.linen as nn
+    import optax
+
+    # a model UNIQUE to this test: if any earlier test in the process
+    # compiled the identical program, the runtime can serve it without
+    # touching the freshly-redirected cache dir and the entry-count
+    # assertion below reads empty (observed in full-suite runs)
+    class OddModel(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(9)(nn.relu(nn.Dense(17)(x)))
+
+    def make(mesh=None):
+        return Trainer(
+            model=OddModel(),
+            optimizer=optax.adam(1e-3),
+            loss_fn=lambda labels, preds: (preds ** 2).mean(),
+            mesh=mesh,
+        )
+
+    trainer = make()
     batch = _batch()
     # fresh cache dir: the per-user cache persists across suite runs, so
     # the prewarmed executable may already be present there
@@ -59,10 +76,7 @@ def test_prewarm_populates_cache_and_matches_live_compile(tmp_path):
     )
     # a live trainer on the prewarmed 4-device mesh trains correctly
     mesh = mesh_lib.create_mesh(jax.devices()[:4])
-    live = Trainer(
-        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
-        mesh=mesh,
-    )
+    live = make(mesh)
     state = live.init_state(jax.random.PRNGKey(0), batch["features"])
     state, loss = live.train_on_batch(state, batch)
     assert np.isfinite(float(np.asarray(loss)))
