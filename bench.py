@@ -579,23 +579,37 @@ def bench_full():
     number and the BERT/MNIST sub-benches so every round records the
     compute-bound MFU alongside the sparse path (VERDICT r3 next-round
     items 1 and 2)."""
+    def attempt(fn, tries=2):
+        # the tunneled compile service intermittently drops connections
+        # ("response body closed before all bytes were read"); a retry
+        # reliably succeeds, and losing a sub-bench loses a round of
+        # recorded evidence
+        last = None
+        for _ in range(tries):
+            try:
+                return fn(), None
+            except Exception as exc:
+                last = exc
+        return None, last
+
     result = bench_deepfm()
-    try:
-        result["detail"].update(bench_deepfm_e2e())
-        synth = result["value"]
-        e2e = result["detail"]["e2e_examples_per_sec"]
-        result["detail"]["e2e_vs_synthetic"] = round(e2e / synth, 3)
-    except Exception as exc:  # record, don't lose the headline
-        result["detail"]["e2e_error"] = repr(exc)
+    e2e, err = attempt(bench_deepfm_e2e)
+    if e2e is not None:
+        result["detail"].update(e2e)
+        result["detail"]["e2e_vs_synthetic"] = round(
+            e2e["e2e_examples_per_sec"] / result["value"], 3
+        )
+    else:  # record, don't lose the headline
+        result["detail"]["e2e_error"] = repr(err)
     for key, fn in (("bert_base_finetune", bench_bert),
                     ("mnist_cnn", bench_mnist)):
-        try:
-            sub = fn()
+        sub, err = attempt(fn)
+        if sub is not None:
             result["detail"][key] = {
                 "examples_per_sec": sub["value"], **sub["detail"]
             }
-        except Exception as exc:
-            result["detail"][f"{key}_error"] = repr(exc)
+        else:
+            result["detail"][f"{key}_error"] = repr(err)
     return result
 
 
