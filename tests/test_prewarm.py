@@ -61,6 +61,19 @@ def test_prewarm_populates_cache_and_matches_live_compile(tmp_path):
     # a warm-machine compile can beat the 0.5s persistence threshold and
     # write nothing — persist everything for this test
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    def reset_cache_singleton():
+        # the persistent cache binds its directory at FIRST use; in a
+        # full-suite process that happened long ago at the conftest dir,
+        # and a mid-process config update is otherwise ignored
+        try:
+            from jax._src import compilation_cache as cc
+
+            cc.reset_cache()
+        except Exception:
+            pass
+
+    reset_cache_singleton()
     try:
         before = _cache_files()
         trainer.prewarm_for_device_counts(batch, [4], block=True)
@@ -70,6 +83,7 @@ def test_prewarm_populates_cache_and_matches_live_compile(tmp_path):
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", prev_min
         )
+        reset_cache_singleton()
     assert after - before, (
         "prewarm produced no new persistent-cache entries "
         f"(cache dir: {tmp_path})"
