@@ -116,6 +116,20 @@ def _pallas_forward(q, k, v, causal: bool, scale: float, block_q: int,
         k_len=k_len,
         block_q=block_q,
     )
+    # Outputs inherit the inputs' varying-axes type (vma): inside a
+    # shard_map with the varying-axis audit on, an untyped out_shape is a
+    # ValueError — which round 4's blanket except silently converted into
+    # the O(L^2) fallback on every single-chip run (round-5 profile
+    # finding).  Older jax without vma typing skips the annotation.
+    def out_struct(shape, dtype):
+        try:
+            vma = frozenset().union(
+                *(jax.typeof(x).vma for x in (q, k, v))
+            )
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except (AttributeError, TypeError):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -129,8 +143,8 @@ def _pallas_forward(q, k, v, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, q_len, dim), q.dtype),
-            jax.ShapeDtypeStruct((bh, q_len, 1), jnp.float32),
+            out_struct((bh, q_len, dim), q.dtype),
+            out_struct((bh, q_len, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -147,8 +161,21 @@ def _flash(q, k, v, causal, scale):
 
 def _flash_fwd(q, k, v, causal, scale):
     bh, q_len, dim = q.shape
-    block_q = min(128, q_len)
-    block_k = min(128, k.shape[1])
+    # 512-sized tiles measured ~1.6x the 128-tile rate on v5e (8.3 vs 5.0
+    # TFLOPs solo at BERT-base shapes): per-grid-program overhead
+    # dominates these small-matmul kernels, so fewer/larger programs win.
+    # Scoped-VMEM budget stays comfortable: the f32 logits/p tiles are
+    # block_q*block_k*4B*2 = 2MB of the 16MB scope.  Blocks must divide
+    # the lengths (the grid streams whole tiles), so take the largest
+    # dividing tile.
+    def pick_block(length):
+        for cand in (512, 256, 128):
+            if length >= cand and length % cand == 0:
+                return cand
+        return length
+
+    block_q = pick_block(q_len)
+    block_k = pick_block(k.shape[1])
     out, lse = _pallas_forward(
         q, k, v, causal, scale, block_q, block_k, _use_interpret()
     )
@@ -195,6 +222,23 @@ def _flash_bwd(causal, scale, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_shapes_ok(q_shape, k_shape) -> bool:
+    """Whether (B, L, H, D) q/k shapes satisfy the kernel's tile
+    constraints (L multiple of 128 or a sub-128 multiple of 8, D <= 128).
+    Callers dispatch on THIS instead of catching ValueError from
+    `flash_attention` — a blanket except around a traced call swallowed an
+    unrelated shard_map vma error for a full round and silently downgraded
+    the bench to the O(L^2) reference path (round-5 profile finding)."""
+    def bad(length):
+        return (length >= 128 and length % 128 != 0) or (
+            length < 128 and length % 8 != 0
+        )
+
+    return not (
+        bad(q_shape[1]) or bad(k_shape[1]) or q_shape[3] > 128
+    )
+
+
 def flash_attention(
     q, k, v, causal: bool = False, scale: Optional[float] = None
 ):
@@ -219,15 +263,11 @@ def flash_attention(
         return full_attention_reference(q, k, v, causal=causal, scale=scale)
     batch, q_len, heads, dim = q.shape
     k_len = k.shape[1]
-
-    def bad(length):
-        return (length >= 128 and length % 128 != 0) or (
-            length < 128 and length % 8 != 0
-        )
-
-    # K is validated too: an un-tileable k_len would silently DROP the
-    # tail keys (the kernel streams k_len // block_k whole tiles).
-    if bad(q_len) or bad(k_len) or k.shape != v.shape or dim > 128:
+    # The SAME predicate callers dispatch on (an un-tileable k_len would
+    # silently DROP tail keys — the kernel streams whole tiles); a
+    # separate inline copy here could drift from flash_shapes_ok and
+    # reintroduce the uncaught-ValueError-in-shard_map failure mode.
+    if not flash_shapes_ok(q.shape, k.shape) or k.shape != v.shape:
         raise ValueError(
             f"flash_attention needs L a multiple of 128 (or a sub-128 "
             f"multiple of 8) for BOTH q and k/v, k.shape == v.shape, and "

@@ -110,10 +110,22 @@ def ring_self_attention(
         # on its local batch, so inputs stay batch-sharded and the output
         # keeps the documented sharding (a bare call would force full
         # replication under jit).  Tile-shape constraints (L % 128,
-        # D <= 128) fall back to the fused-lax ring body with ring size 1.
-        from elasticdl_tpu.ops.flash_attention import flash_attention
+        # D <= 128) take the fused-lax ring body with ring size 1 instead
+        # — dispatched on an EXPLICIT shape check: a blanket
+        # `except ValueError` here once swallowed a shard_map vma error
+        # and silently downgraded every single-chip run (bench included)
+        # to the O(L^2) path (round-5 on-chip profile finding).
+        # check_vma=False: the kernel types its outputs' vma from its
+        # inputs for real TPU lowering, but interpret mode (CPU tests)
+        # re-evaluates the kernel body where the block-slicing internals
+        # mix varying and invariant operands and fail the audit; the
+        # wrapper's in/out specs still pin the sharding contract.
+        from elasticdl_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_shapes_ok,
+        )
 
-        try:
+        if flash_shapes_ok(q.shape, k.shape):
             return jax.shard_map(
                 functools.partial(
                     flash_attention, causal=causal, scale=scale
@@ -121,9 +133,8 @@ def ring_self_attention(
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
+                check_vma=False,
             )(q, k, v)
-        except ValueError:
-            pass
     fn = functools.partial(
         _ring_attention_local,
         ring_size=ring_size,

@@ -69,7 +69,10 @@ class LocalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from elasticdl_tpu.ops.flash_attention import flash_attention
+        from elasticdl_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_shapes_ok,
+        )
         from elasticdl_tpu.ops.ring_attention import full_attention_reference
 
         batch, length, _ = x.shape
@@ -78,9 +81,20 @@ class LocalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, length, self.heads, head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
-        try:
+        # Explicit tile-shape dispatch — a try/except here once swallowed
+        # an unrelated shard_map typing error and silently took the
+        # O(L^2) path (round-5 profile finding).  TPU-backend only: this
+        # runs INSIDE the pipeline's vma-audited shard_map, where the
+        # CPU interpreter's block-slicing internals fail the audit; the
+        # reference path is the same math, and the kernel itself is
+        # covered by tests/test_flash_attention.py in interpret mode.
+        import jax
+
+        if jax.default_backend() == "tpu" and flash_shapes_ok(
+            q.shape, k.shape
+        ):
             out = flash_attention(q, k, v, causal=False)
-        except ValueError:  # un-tileable shape (trace-time check)
+        else:
             out = full_attention_reference(q, k, v, causal=False)
         return nn.Dense(self.hidden, name="out", dtype=self.dtype)(
             out.reshape(batch, length, self.hidden)
