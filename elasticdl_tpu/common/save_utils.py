@@ -18,6 +18,58 @@ from elasticdl_tpu.common.log_utils import get_logger
 logger = get_logger(__name__)
 
 
+def _swap_tree_keys(node, old: str, new: str):
+    """Recursively rename dict keys `old` -> `new` through the mixed
+    containers a TrainState template is made of (dicts, flax struct
+    dataclasses, optax NamedTuple states, lists/tuples).  Raises on a
+    collision (a subtree already holding BOTH names) — the shim must
+    never silently merge two distinct params."""
+    if isinstance(node, dict):
+        if old in node and new in node:
+            raise ValueError(
+                f"cannot rename {old!r} -> {new!r}: both keys present"
+            )
+        return {
+            (new if k == old else k): _swap_tree_keys(v, old, new)
+            for k, v in node.items()
+        }
+    if hasattr(node, "_fields"):          # NamedTuple (optax states)
+        return type(node)(
+            *(_swap_tree_keys(v, old, new) for v in node)
+        )
+    if hasattr(node, "__dataclass_fields__"):   # flax struct (TrainState)
+        import dataclasses
+
+        return type(node)(
+            **{
+                f.name: _swap_tree_keys(getattr(node, f.name), old, new)
+                for f in dataclasses.fields(node)
+            }
+        )
+    if isinstance(node, (list, tuple)):
+        return type(node)(_swap_tree_keys(v, old, new) for v in node)
+    return node
+
+
+def _tree_has_key(node, key: str) -> bool:
+    if isinstance(node, dict):
+        return key in node or any(
+            _tree_has_key(v, key) for v in node.values()
+        )
+    if hasattr(node, "_fields"):
+        return any(_tree_has_key(v, key) for v in node)
+    if hasattr(node, "__dataclass_fields__"):
+        import dataclasses
+
+        return any(
+            _tree_has_key(getattr(node, f.name), key)
+            for f in dataclasses.fields(node)
+        )
+    if isinstance(node, (list, tuple)):
+        return any(_tree_has_key(v, key) for v in node)
+    return False
+
+
 class CheckpointSaver:
     def __init__(
         self,
@@ -71,11 +123,51 @@ class CheckpointSaver:
             else x,
             template,
         )
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
-        )
+        restored = self._restore_with_shims(step, abstract)
         logger.info("Restored checkpoint step %d (eval-at-version)", step)
         return restored
+
+    def _restore_with_shims(self, step: int, abstract: Any) -> Any:
+        """StandardRestore, with a legacy-key migration fallback: round 4
+        renamed the GPipe stack param `stack` -> `gpipe_stack` (ADVICE
+        r4) — a pre-rename checkpoint restores by renaming the keys in
+        the TEMPLATE (everywhere: params AND the optimizer's mirrored
+        moment trees), then renaming them back in the restored tree, so
+        old pipelined checkpoints load without manual surgery."""
+        import orbax.checkpoint as ocp
+
+        try:
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except Exception:
+            # Retry with the legacy template ONLY when the stored tree
+            # really has the old key layout — re-running restore after an
+            # unrelated failure (corrupt files, dtype mismatch, transient
+            # FS error) would bury the real error under a phantom
+            # key-migration failure.
+            if not _tree_has_key(abstract, "gpipe_stack"):
+                raise
+            try:
+                stored = self._mngr.item_metadata(step)
+                # TreeMetadata wraps the key layout in `.tree`
+                stored = getattr(stored, "tree", stored)
+            except Exception:
+                stored = None
+            if stored is not None and not (
+                _tree_has_key(stored, "stack")
+                and not _tree_has_key(stored, "gpipe_stack")
+            ):
+                raise
+            legacy = _swap_tree_keys(abstract, "gpipe_stack", "stack")
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(legacy)
+            )
+            logger.info(
+                "Restored checkpoint step %d via legacy GPipe key shim "
+                "(stack -> gpipe_stack)", step,
+            )
+            return _swap_tree_keys(restored, "stack", "gpipe_stack")
 
     def maybe_restore(self, template: Any) -> Optional[Any]:
         """Restore the newest checkpoint into the sharding/structure of
@@ -94,9 +186,7 @@ class CheckpointSaver:
             else x,
             template,
         )
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
-        )
+        restored = self._restore_with_shims(step, abstract)
         logger.info("Restored checkpoint step %d", step)
         return restored
 

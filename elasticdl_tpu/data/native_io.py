@@ -25,20 +25,37 @@ _build_attempted = False
 
 
 def _try_build() -> None:
+    """Build the .so (at most once per process).  Cross-process safe
+    (ADVICE r4): the Makefile compiles to a temp name and atomically
+    renames, so a concurrent reader never dlopens a half-written file,
+    and an flock on a sidecar lockfile serializes concurrent makes so N
+    workers starting together run one compile, not N."""
     global _build_attempted
     if _build_attempted:
         return
     _build_attempted = True
-    makefile = os.path.join(_ROOT, "native", "Makefile")
-    if not os.path.exists(makefile):
+    native_dir = os.path.join(_ROOT, "native")
+    if not os.path.exists(os.path.join(native_dir, "Makefile")):
         return
     try:
-        subprocess.run(
-            ["make", "-C", os.path.join(_ROOT, "native")],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        import fcntl
+    except ImportError:
+        fcntl = None          # non-POSIX: build unlocked (still atomic)
+    try:
+        os.makedirs(os.path.join(native_dir, "build"), exist_ok=True)
+        with open(os.path.join(native_dir, "build", ".lock"), "w") as lock:
+            if fcntl is not None:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                subprocess.run(
+                    ["make", "-C", native_dir],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            finally:
+                if fcntl is not None:
+                    fcntl.flock(lock, fcntl.LOCK_UN)
     except (subprocess.SubprocessError, OSError):
         pass
 
@@ -59,7 +76,12 @@ def _load():
         _try_build()
     if not os.path.exists(_SO_PATH):
         return None
-    lib = ctypes.CDLL(_SO_PATH)
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        # corrupt artifact (e.g. from an interrupted historical build):
+        # degrade to the pure-Python path rather than crash the worker
+        return None
     lib.recordio_build_index.restype = ctypes.c_int64
     lib.recordio_build_index.argtypes = [
         ctypes.c_char_p,

@@ -166,3 +166,45 @@ def test_task_data_service_bulk_batches(tmp_path):
         jax.tree.map(
             lambda a, b: np.testing.assert_array_equal(a, b), sb, bb
         )
+
+
+def test_bulk_path_chunks_large_tasks(tmp_path):
+    """ADVICE r4: the bulk fast path must not materialize a whole large
+    task in host memory — reads are issued in batch-aligned sub-ranges
+    of at most BULK_CHUNK_BATCHES batches, and the reassembled stream is
+    identical to an unchunked read."""
+    path = str(tmp_path / "big.tfrecord")
+    n = 530  # > BULK_CHUNK_BATCHES(16) * batch(8) = 128 records per chunk
+    payloads = [bytes([i % 251]) * 16 for i in range(n)]
+    write_tfrecords(path, payloads)
+    reader = TFRecordDataReader(path)
+    calls = []
+    orig = reader.read_records_bulk
+
+    def spy(task):
+        calls.append((task.shard.start, task.shard.end))
+        return orig(task)
+
+    reader.read_records_bulk = spy
+    service = TaskDataService(None, reader, worker_id=0)
+    task = pb.Task(
+        task_id=1, type=pb.TRAINING,
+        shard=pb.Shard(name=path, start=0, end=n),
+    )
+    batch_size = 8
+
+    def feed_bulk(buffer, sizes):
+        assert (np.asarray(sizes) == 16).all()
+        return {"x": np.frombuffer(buffer, np.uint8).reshape(-1, 16)}
+
+    got = list(service.batches_for_task(task, batch_size, None, feed_bulk))
+    # multiple bounded sub-reads, each at most the chunk size
+    chunk = TaskDataService.BULK_CHUNK_BATCHES * batch_size
+    assert len(calls) == -(-n // chunk)
+    assert all(end - start <= chunk for start, end in calls)
+    # stream identical to the payloads, with only the tail wrap-padded
+    rows = np.concatenate([b["x"] for b, _ in got])
+    reals = [r for _, r in got]
+    assert sum(reals) == n
+    expect = np.frombuffer(b"".join(payloads), np.uint8).reshape(-1, 16)
+    np.testing.assert_array_equal(rows[:n], expect)

@@ -76,3 +76,60 @@ def test_maybe_restore_empty_dir_returns_none(tmp_path):
     saver = CheckpointSaver(str(tmp_path / "empty"), async_save=False)
     assert saver.maybe_restore(template=None) is None
     saver.close()
+
+
+def test_legacy_gpipe_stack_key_restores(tmp_path):
+    """ADVICE r4: round 4 renamed the GPipe stack param `stack` ->
+    `gpipe_stack`; a pre-rename checkpoint must restore through the
+    legacy-key shim (template keys renamed for the read, restored tree
+    renamed back — including the optimizer's mirrored moment trees)."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.common.save_utils import _swap_tree_keys
+
+    spec = get_model_spec(
+        "model_zoo", "bert.bert_finetune.custom_model",
+        model_params=(
+            "hidden=32;num_layers=2;heads=2;mlp_dim=64;max_len=16;"
+            "vocab_size=32;pipeline_microbatches=2"
+        ),
+    )
+    trainer = Trainer(
+        model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+        param_sharding_fn=spec.param_sharding,
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "input_ids": rng.randint(0, 32, size=(8, 16)).astype(np.int32)
+        },
+        "labels": rng.randint(0, 2, 8).astype(np.int32),
+    }
+    state = trainer.init_state(jax.random.PRNGKey(0), batch["features"])
+    state, _ = trainer.train_on_batch(state, batch)
+
+    # write a checkpoint AS A PRE-ROUND-4 JOB WOULD HAVE: stack keys
+    # named `stack` throughout (params and adam moments)
+    legacy_state = _swap_tree_keys(state, "gpipe_stack", "stack")
+    saver = CheckpointSaver(str(tmp_path / "ckpt"), async_save=False)
+    assert saver.save(legacy_state, force=True)
+    saver.wait_until_finished()
+
+    template = trainer.init_state(
+        jax.random.PRNGKey(7), batch["features"]
+    )
+    restored = saver.maybe_restore(template)
+    assert restored is not None
+    # modern key layout, legacy values
+    flat_r = jax.tree_util.tree_flatten_with_path(restored.params)[0]
+    assert any(
+        "gpipe_stack" in "/".join(getattr(k, "key", str(k)) for k in p)
+        for p, _ in flat_r
+    )
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and training continues from it
+    s2, loss = trainer.train_on_batch(restored, batch)
+    assert np.isfinite(float(loss))
+    saver.close()
