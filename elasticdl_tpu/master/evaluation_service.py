@@ -370,10 +370,19 @@ class EvaluationService:
             n, sampled = agg.num_examples, agg.sample_rows
         for attempt in range(4 if heavy is not None else 0):
             generation, labels, preds, width = heavy
+            if not labels:
+                # chunks vanished between snapshot and publish (version
+                # pruned or sample cap tripped): the lock holder that
+                # dropped them froze the best available value into
+                # history already — publishing {**weighted_means} here
+                # would OVERWRITE that frozen exact result
+                break
             exact = _exact_metrics(
                 labels, preds, width, self._eval_metrics
             )
             with self._lock:
+                if agg.samples_dropped:
+                    break
                 if agg.generation == generation:
                     merged = {**agg.weighted_means(), **exact}
                     self.history[version] = merged
