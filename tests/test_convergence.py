@@ -1,9 +1,10 @@
-"""Convergence regression pins (docs/CONVERGENCE.md): the DeepFM and
-MNIST fixed-seed trajectories must not regress.  SURVEY §7 hard part 4 —
-bulk-synchronous SPMD replaced the reference's async-PS semantics, so
+"""Convergence regression pins (docs/CONVERGENCE.md): every zoo
+family's fixed-seed trajectory must not regress.  SURVEY §7 hard part 4
+— bulk-synchronous SPMD replaced the reference's async-PS semantics, so
 convergence is baselined by measurement; these tests keep the baseline
-honest at suite speed (the full 5-config table is regenerated with
-scripts/record_convergence.py)."""
+honest (VERDICT r4 item 3: all five configs pinned; regenerate the
+recorded values with scripts/record_convergence.py after optimizer or
+model changes)."""
 
 import os
 import runpy
@@ -20,23 +21,57 @@ _MOD = runpy.run_path(
 MARGIN = 0.01
 
 
+def _assert_not_regressed(name, curve, recorded, margins=None):
+    for step, value in recorded.items():
+        margin = (margins or {}).get(step, MARGIN)
+        assert curve[step] >= value - margin, (
+            f"{name} regressed at step {step}: "
+            f"{curve[step]} < {value} (recorded) - {margin}"
+        )
+
+
 def test_deepfm_trajectory_not_regressed():
     name, metric, curve = _MOD["deepfm"]()
     assert metric == "auc"
-    recorded = {16: 0.7894, 32: 0.8071, 64: 0.8224}
-    for step, value in recorded.items():
-        assert curve[step] >= value - MARGIN, (
-            f"DeepFM AUC regressed at step {step}: "
-            f"{curve[step]} < {value} (recorded) - {MARGIN}"
-        )
+    _assert_not_regressed(
+        "DeepFM AUC", curve, {16: 0.7892, 32: 0.8070, 64: 0.8223}
+    )
 
 
 def test_mnist_trajectory_not_regressed():
     name, metric, curve = _MOD["mnist"]()
     assert metric == "accuracy"
-    recorded = {15: 1.0, 30: 1.0, 60: 1.0}
-    for step, value in recorded.items():
-        assert curve[step] >= value - MARGIN, (
-            f"MNIST accuracy regressed at step {step}: "
-            f"{curve[step]} < {value} (recorded) - {MARGIN}"
-        )
+    _assert_not_regressed(
+        "MNIST accuracy", curve, {15: 1.0, 30: 1.0, 60: 1.0}
+    )
+
+
+def test_wide_deep_trajectory_not_regressed():
+    name, metric, curve = _MOD["census"]()
+    assert metric == "auc"
+    _assert_not_regressed(
+        "Wide&Deep AUC", curve, {16: 0.5447, 32: 0.5836, 64: 0.7408}
+    )
+
+
+def test_resnet_trajectory_not_regressed():
+    name, metric, curve = _MOD["cifar10"]()
+    assert metric == "accuracy"
+    # step 8 sits mid-descent and wobbles ~0.01 across BLAS variants;
+    # step 16 (memorized) is the tight signal
+    _assert_not_regressed(
+        "ResNet accuracy", curve, {8: 0.6543, 16: 0.998},
+        margins={8: 0.03},
+    )
+
+
+def test_bert_trajectory_not_regressed():
+    name, metric, curve = _MOD["bert"]()
+    assert metric == "accuracy"
+    # the break-from-chance step (~200) is chaotic under numerics
+    # changes (docs/CONVERGENCE.md round-5 note): step 256 gets a wide
+    # band; the end of curve is the regression pin
+    _assert_not_regressed(
+        "BERT accuracy", curve, {128: 0.4814, 256: 0.9648, 384: 0.9922},
+        margins={128: 0.05, 256: 0.20, 384: 0.02},
+    )
