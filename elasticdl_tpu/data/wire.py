@@ -58,6 +58,68 @@ def unpack_uint24(packed):
     return p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16)
 
 
+B22_MAX = (1 << 22) - 1
+
+
+def pack_int_to_b22(ids: np.ndarray) -> dict:
+    """Host-side: (B, F) non-negative ids < 2^22 -> {"lo16": (B, F)
+    uint16, "hi6": (B, ceil(6F/8)) uint8} — 2.75 bytes/id instead of
+    uint24's 3.  The high 6 bits of each id are bit-packed contiguously
+    (little-endian within the hi6 byte stream).  Vectorized: one shift +
+    one astype + F or-accumulates into the packed buffer."""
+    ids = np.asarray(ids)
+    if ids.ndim != 2:
+        raise ValueError(f"b22 packing needs (B, F) ids; got {ids.shape}")
+    if ids.size and (ids.min() < 0 or ids.max() > B22_MAX):
+        raise ValueError(
+            f"b22 packing needs ids in [0, {B22_MAX}]; got "
+            f"[{ids.min()}, {ids.max()}]"
+        )
+    b, f = ids.shape
+    lo16 = (ids & 0xFFFF).astype(np.uint16)
+    hi6 = (ids >> 16).astype(np.uint32)               # 6 significant bits
+    nbytes = (6 * f + 7) // 8
+    packed = np.zeros((b, nbytes), np.uint16)         # u16: carry room
+    for k in range(f):
+        bit = 6 * k
+        byte, shift = bit >> 3, bit & 7
+        word = (hi6[:, k] << shift).astype(np.uint32)
+        packed[:, byte] |= (word & 0xFF).astype(np.uint16)
+        if byte + 1 < nbytes:
+            packed[:, byte + 1] |= ((word >> 8) & 0xFF).astype(np.uint16)
+    return {"lo16": lo16, "hi6": packed.astype(np.uint8)}
+
+
+def unpack_b22(packed: dict):
+    """Device-side: invert pack_int_to_b22 -> (B, F) int32.  Static
+    index/shift tables; XLA fuses the gathers+shifts into the id
+    consumer."""
+    import jax.numpy as jnp
+
+    lo16 = packed["lo16"].astype(jnp.int32)           # (B, F)
+    hi6 = packed["hi6"].astype(jnp.int32)             # (B, nbytes)
+    f = lo16.shape[-1]
+    nbytes = hi6.shape[-1]
+    bits = 6 * np.arange(f)
+    byte_idx = (bits >> 3).astype(np.int32)
+    shifts = jnp.asarray(bits & 7, jnp.int32)
+    lo_b = hi6[..., byte_idx]
+    nxt = np.minimum(byte_idx + 1, nbytes - 1).astype(np.int32)
+    hi_b = jnp.where(
+        jnp.asarray(byte_idx + 1 < nbytes), hi6[..., nxt], 0
+    )
+    hi = ((lo_b | (hi_b << 8)) >> shifts) & 0x3F      # (B, F)
+    return lo16 | (hi << 16)
+
+
+def is_packed_b22(obj) -> bool:
+    """The b22 compact-id convention: a dict with lo16/hi6 arrays."""
+    return (
+        isinstance(obj, dict)
+        and set(obj) == {"lo16", "hi6"}
+    )
+
+
 def is_packed_uint24(arr) -> bool:
     """The compact-id convention: a trailing length-3 uint8 axis."""
     return (

@@ -62,9 +62,16 @@ class DeepFM(nn.Module):
     @nn.compact
     def __call__(self, features):
         sparse = features["sparse"]
-        from elasticdl_tpu.data.wire import is_packed_uint24, unpack_uint24
+        from elasticdl_tpu.data.wire import (
+            is_packed_b22,
+            is_packed_uint24,
+            unpack_b22,
+            unpack_uint24,
+        )
 
-        if is_packed_uint24(sparse):          # compact wire format
+        if is_packed_b22(sparse):             # compact wire formats
+            sparse = unpack_b22(sparse)
+        elif is_packed_uint24(sparse):
             sparse = unpack_uint24(sparse)
         field_ids = field_offset_ids(sparse)               # (B, 26)
 
@@ -169,20 +176,20 @@ def feed_bulk(buffer, sizes, metadata=None):
 
 def feed_bulk_compact(buffer, sizes, metadata=None):
     """feed_bulk with the compact device wire format
-    (elasticdl_tpu.data.wire): dense bf16, sparse uint24-packed, labels
-    uint8 — 105 bytes/example on the link instead of 160.  The model
-    unpacks on device (fused by XLA); dense values round through bf16
-    (<0.4% relative — they feed a log1p squash recomputed in f32).
-    Raw Criteo-style ids must fit 24 bits; this zoo's record format
-    guarantees ids < 2^22."""
-    from elasticdl_tpu.data.wire import pack_f32_to_bf16, pack_int_to_uint24
+    (elasticdl_tpu.data.wire): dense bf16, sparse b22-packed (uint16
+    low halves + bit-packed high 6), labels uint8 — 99 bytes/example on
+    the link instead of 160.  The model unpacks on device (fused by
+    XLA); dense values round through bf16 (<0.4% relative — they feed a
+    log1p squash recomputed in f32).  This zoo's record format
+    guarantees ids < 2^22, the b22 bound."""
+    from elasticdl_tpu.data.wire import pack_f32_to_bf16, pack_int_to_b22
 
     batch = feed_bulk(buffer, sizes, metadata)
     features = batch["features"]
     return {
         "features": {
             "dense": pack_f32_to_bf16(features["dense"]),
-            "sparse": pack_int_to_uint24(features["sparse"]),
+            "sparse": pack_int_to_b22(features["sparse"]),
         },
         "labels": batch["labels"].astype(np.uint8),
     }

@@ -21,6 +21,35 @@ def test_uint24_roundtrip():
     np.testing.assert_array_equal(unpacked, ids.astype(np.int32))
 
 
+def test_b22_roundtrip():
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, wire.B22_MAX + 1, size=(64, 26)).astype(np.int64)
+    packed = wire.pack_int_to_b22(ids)
+    assert wire.is_packed_b22(packed)
+    assert packed["lo16"].dtype == np.uint16
+    assert packed["hi6"].dtype == np.uint8
+    # 2.75 bytes/id (vs uint24's 3): 26 ids -> 52 + 20 bytes
+    assert packed["lo16"].shape == (64, 26)
+    assert packed["hi6"].shape == (64, 20)
+    unpacked = np.asarray(wire.unpack_b22(packed))
+    np.testing.assert_array_equal(unpacked, ids.astype(np.int32))
+    # edge cases: all-zero, all-max, single field
+    for edge in (np.zeros((3, 26), np.int64),
+                 np.full((3, 26), wire.B22_MAX, np.int64),
+                 np.arange(4)[None].astype(np.int64) * 1000003 % (1 << 22)):
+        np.testing.assert_array_equal(
+            np.asarray(wire.unpack_b22(wire.pack_int_to_b22(edge))),
+            edge.astype(np.int32),
+        )
+
+
+def test_b22_bounds_rejected():
+    with pytest.raises(ValueError):
+        wire.pack_int_to_b22(np.array([[1 << 22]]))
+    with pytest.raises(ValueError):
+        wire.pack_int_to_b22(np.array([[-1]]))
+
+
 def test_uint24_bounds_rejected():
     with pytest.raises(ValueError):
         wire.pack_int_to_uint24(np.array([1 << 24]))
