@@ -13,6 +13,9 @@ inside the train step where XLA fuses them into the first consumers):
   normalize/cast to f32 on device are unaffected in shape or API);
 - int32 ids < 2^24 -> packed uint8 triples ("uint24": 3/4 the bytes;
   embedding ids after hashing/modding live comfortably under 2^24);
+- int32 ids < 2^22 -> "b22": uint16 low halves + a bit-packed high-6
+  stream (2.75 bytes/id — the tighter format DeepFM's compact feed
+  ships, 99 bytes/example for its record);
 - int labels -> uint8.
 
 The zoo opts in by exporting `feed_bulk_compact` (same signature as
@@ -79,15 +82,17 @@ def pack_int_to_b22(ids: np.ndarray) -> dict:
     lo16 = (ids & 0xFFFF).astype(np.uint16)
     hi6 = (ids >> 16).astype(np.uint32)               # 6 significant bits
     nbytes = (6 * f + 7) // 8
-    packed = np.zeros((b, nbytes), np.uint16)         # u16: carry room
+    # |= of disjoint bit fields never carries, so the packed buffer can
+    # be uint8 directly
+    packed = np.zeros((b, nbytes), np.uint8)
     for k in range(f):
         bit = 6 * k
         byte, shift = bit >> 3, bit & 7
         word = (hi6[:, k] << shift).astype(np.uint32)
-        packed[:, byte] |= (word & 0xFF).astype(np.uint16)
+        packed[:, byte] |= (word & 0xFF).astype(np.uint8)
         if byte + 1 < nbytes:
-            packed[:, byte + 1] |= ((word >> 8) & 0xFF).astype(np.uint16)
-    return {"lo16": lo16, "hi6": packed.astype(np.uint8)}
+            packed[:, byte + 1] |= ((word >> 8) & 0xFF).astype(np.uint8)
+    return {"lo16": lo16, "hi6": packed}
 
 
 def unpack_b22(packed: dict):
