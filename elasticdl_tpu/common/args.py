@@ -205,6 +205,13 @@ def add_train_params(parser: argparse.ArgumentParser):
     )
     parser.add_argument("--use_bf16", type=str2bool, default=True,
                         help="compute in bfloat16 on the MXU where safe")
+    parser.add_argument(
+        "--compact_wire", type=str2bool, default=False,
+        help="ship batches in the zoo's compact device wire format "
+        "(feed_bulk_compact, elasticdl_tpu.data.wire) when the zoo "
+        "provides one — fewer host->device bytes per example on "
+        "bandwidth-limited links",
+    )
     parser.add_argument("--data_reader_params", default="")
     parser.add_argument("--records_per_task", type=pos_int, default=4096)
     parser.add_argument(

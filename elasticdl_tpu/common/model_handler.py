@@ -15,6 +15,11 @@ model definitions port by re-implementing bodies in Flax/Optax:
                                  parse of a contiguous uint8 payload
                                  buffer + int64 per-record sizes — the
                                  fast path for fixed-width records)
+    feed_bulk_compact(buffer, sizes, metadata) -> batch dict (optional;
+                                 feed_bulk in the zoo's compact device
+                                 wire format — elasticdl_tpu.data.wire —
+                                 selected by --compact_wire; the model
+                                 must accept the compact dtypes)
     param_sharding(path,leaf) -> PartitionSpec | None (optional; TPU-native
                                  extension for sharded embeddings / TP)
 
@@ -46,6 +51,7 @@ class ModelSpec:
     optimizer: Any
     feed: Callable
     feed_bulk: Optional[Callable] = None
+    feed_bulk_compact: Optional[Callable] = None
     eval_metrics: Dict[str, Callable] = field(default_factory=dict)
     custom_data_reader: Optional[Callable] = None
     callbacks: list = field(default_factory=list)
@@ -133,6 +139,7 @@ def get_model_spec(
         optimizer=_call_with_params(opt(optimizer), model_params),
         feed=opt(dataset_fn),
         feed_bulk=opt("feed_bulk", required=False),
+        feed_bulk_compact=opt("feed_bulk_compact", required=False),
         eval_metrics=metrics_factory() if metrics_factory else {},
         custom_data_reader=reader_factory,
         callbacks=callbacks_factory() if callbacks_factory else [],

@@ -252,9 +252,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # Per-program K/V VMEM residency ceiling: the (B, L, H*D)-layout kernel
 # holds a WHOLE (k_len, H*D) K and V block per program (H-fold more than
 # gen-1's per-head blocks), so very long local sequences at wide head
-# counts stop fitting VMEM.  8M elements ~ 12 MB bf16 per block (~48 MB
-# with V and double buffering) compiles comfortably; beyond it callers
-# fall back to the fused-lax ring body, which handles any length.
+# counts stop fitting VMEM.  8M elements = 16 MB bf16 per block (~64 MB
+# with V and double buffering, of the ~128 MB VMEM) compiles
+# comfortably; beyond it callers fall back to the fused-lax ring body,
+# which handles any length.
 _MAX_KV_BLOCK_ELEMENTS = 8 * 1024 * 1024
 
 
@@ -310,8 +311,10 @@ def flash_attention(
     if not flash_shapes_ok(q.shape, k.shape) or k.shape != v.shape:
         raise ValueError(
             f"flash_attention needs L a multiple of 128 (or a sub-128 "
-            f"multiple of 8) for BOTH q and k/v, k.shape == v.shape, and "
-            f"D <= 128; got Lq={q.shape[1]}, Lk={k.shape[1]}, "
+            f"multiple of 8) for BOTH q and k/v, k.shape == v.shape, "
+            f"D <= 128, and Lk*H*D <= {_MAX_KV_BLOCK_ELEMENTS} (the "
+            f"per-program K/V VMEM residency ceiling); got "
+            f"Lq={q.shape[1]}, Lk={k.shape[1]}, H={q.shape[2]}, "
             f"D={q.shape[3]}"
         )
     return _flash(q, k, v, causal, scale)

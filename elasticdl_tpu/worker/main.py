@@ -171,6 +171,7 @@ def main(argv=None):
             output_dir=getattr(args, "output", ""),
             wedge_grace_s=args.wedge_grace_s,
             steps_per_execution=getattr(args, "steps_per_execution", 1),
+            compact_wire=getattr(args, "compact_wire", False),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")
@@ -189,6 +190,7 @@ def main(argv=None):
             checkpoint_saver=saver_factory() if saver_factory else None,
             checkpoint_steps=args.checkpoint_steps,
             steps_per_execution=getattr(args, "steps_per_execution", 1),
+            compact_wire=getattr(args, "compact_wire", False),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")

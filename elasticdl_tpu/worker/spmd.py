@@ -126,10 +126,21 @@ class SPMDWorker:
         tensorboard_dir: str = "",
         profile_dir: str = "",
         steps_per_execution: int = 1,
+        compact_wire: bool = False,
     ):
         self.worker_id = worker_id
         self.spec = spec
         self.minibatch_size = minibatch_size
+        # --compact_wire (same contract as Worker): parse straight into
+        # the zoo's compact device wire format when it provides one
+        self.compact_wire = bool(
+            compact_wire and spec.feed_bulk_compact is not None
+        )
+        if compact_wire and spec.feed_bulk_compact is None:
+            logger.warning(
+                "--compact_wire requested but the zoo module defines no "
+                "feed_bulk_compact; using the standard feed"
+            )
         # >1 dispatches that many collective train steps as one jitted
         # scan over a global (K, B, ...) batch stack (deterministic
         # grouping — identical on every rank)
@@ -883,10 +894,15 @@ class SPMDWorker:
     @property
     def _feed_bulk(self):
         """Vectorized-parse closure (same contract as Worker._feed_bulk)."""
-        if self.spec.feed_bulk is None:
+        fn = (
+            self.spec.feed_bulk_compact
+            if self.compact_wire
+            else self.spec.feed_bulk
+        )
+        if fn is None:
             return None
         metadata = getattr(self._reader, "metadata", {})
-        return lambda buf, sizes: self.spec.feed_bulk(buf, sizes, metadata)
+        return lambda buf, sizes: fn(buf, sizes, metadata)
 
 
 from elasticdl_tpu.parallel.collectives import (  # noqa: E402

@@ -109,10 +109,22 @@ class Worker:
         tensorboard_dir: str = "",
         profile_dir: str = "",
         steps_per_execution: int = 1,
+        compact_wire: bool = False,
     ):
         self.worker_id = worker_id
         self.spec = spec
         self.minibatch_size = minibatch_size
+        # --compact_wire: ship batches in the zoo's compact device wire
+        # format when it provides one (fewer H2D bytes/example); the
+        # zoo's model accepts the compact dtypes by contract
+        self.compact_wire = bool(
+            compact_wire and spec.feed_bulk_compact is not None
+        )
+        if compact_wire and spec.feed_bulk_compact is None:
+            logger.warning(
+                "--compact_wire requested but the zoo module defines no "
+                "feed_bulk_compact; using the standard feed"
+            )
         # >1 dispatches that many train steps as ONE jitted lax.scan
         # program (Trainer.train_on_batch_stack) — amortizes per-dispatch
         # overhead, which dominates on remote/tunneled TPU runtimes.
@@ -447,11 +459,18 @@ class Worker:
     @property
     def _feed_bulk(self):
         """Vectorized-parse closure for batches_for_task, or None when the
-        zoo module has no feed_bulk (the streaming feed path then runs)."""
-        if self.spec.feed_bulk is None:
+        zoo module has no feed_bulk (the streaming feed path then runs).
+        With --compact_wire and a zoo feed_bulk_compact, batches parse
+        straight into the compact device wire format."""
+        fn = (
+            self.spec.feed_bulk_compact
+            if self.compact_wire
+            else self.spec.feed_bulk
+        )
+        if fn is None:
             return None
         metadata = getattr(self._reader, "metadata", {})
-        return lambda buf, sizes: self.spec.feed_bulk(buf, sizes, metadata)
+        return lambda buf, sizes: fn(buf, sizes, metadata)
 
 
 def _task_export_config(task: pb.Task) -> dict:

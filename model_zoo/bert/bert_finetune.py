@@ -275,6 +275,25 @@ def feed_bulk(buffer, sizes, metadata=None):
     }
 
 
+def feed_bulk_compact(buffer, sizes, metadata=None):
+    """feed_bulk with the compact device wire format
+    (elasticdl_tpu.data.wire): token ids as uint16 (this zoo's default
+    vocab is 8192; any vocab <= 65536 fits), labels uint8 — halves the
+    record's host->device bytes.  The model casts ids to int32 at entry,
+    so no model change is needed."""
+    batch = feed_bulk(buffer, sizes, metadata)
+    ids = batch["features"]["input_ids"]
+    if ids.size and (ids.min() < 0 or ids.max() >= 1 << 16):
+        raise ValueError(
+            "bert feed_bulk_compact needs token ids in [0, 65536); this "
+            "dataset's don't fit uint16 — use the standard feed"
+        )
+    return {
+        "features": {"input_ids": ids.astype(np.uint16)},
+        "labels": batch["labels"].astype(np.uint8),
+    }
+
+
 def eval_metrics_fn():
     return {
         "accuracy": lambda labels, predictions: float(

@@ -42,6 +42,26 @@ def field_offset_ids(sparse: jnp.ndarray) -> jnp.ndarray:
     return sparse.astype(jnp.int32) + offsets[None, :]
 
 
+def sparse_ids(features) -> jnp.ndarray:
+    """(B, 26) int ids from `features["sparse"]`, whatever wire format it
+    arrived in (plain int32, or the compact b22/uint24 packings from
+    elasticdl_tpu.data.wire).  Shared by every CTR model on this record
+    format so compact-wire support cannot drift between them."""
+    sparse = features["sparse"]
+    from elasticdl_tpu.data.wire import (
+        is_packed_b22,
+        is_packed_uint24,
+        unpack_b22,
+        unpack_uint24,
+    )
+
+    if is_packed_b22(sparse):
+        return unpack_b22(sparse)
+    if is_packed_uint24(sparse):
+        return unpack_uint24(sparse)
+    return sparse
+
+
 def normalize_dense(dense: jnp.ndarray) -> jnp.ndarray:
     """Signed log1p squashing of the 13 dense counters (Criteo-style
     heavy-tailed counts)."""
@@ -61,19 +81,7 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features):
-        sparse = features["sparse"]
-        from elasticdl_tpu.data.wire import (
-            is_packed_b22,
-            is_packed_uint24,
-            unpack_b22,
-            unpack_uint24,
-        )
-
-        if is_packed_b22(sparse):             # compact wire formats
-            sparse = unpack_b22(sparse)
-        elif is_packed_uint24(sparse):
-            sparse = unpack_uint24(sparse)
-        field_ids = field_offset_ids(sparse)               # (B, 26)
+        field_ids = field_offset_ids(sparse_ids(features))  # (B, 26)
 
         # second-order / deep embeddings: (B, 26, k)
         emb = DistributedEmbedding(

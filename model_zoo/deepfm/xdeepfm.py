@@ -33,14 +33,17 @@ from model_zoo.deepfm.deepfm_functional_api import (
     RECORD_BYTES,
     feed,
     feed_bulk,
+    feed_bulk_compact,
     field_offset_ids,
     loss,
     normalize_dense,
     optimizer,
+    sparse_ids,
 )
 
 __all__ = [
     "custom_model", "loss", "optimizer", "feed", "feed_bulk",
+    "feed_bulk_compact",
     "eval_metrics_fn", "param_sharding", "RECORD_BYTES", "NUM_DENSE",
     "NUM_SPARSE",
 ]
@@ -81,7 +84,7 @@ class XDeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features):
-        field_ids = field_offset_ids(features["sparse"])   # (B, 26)
+        field_ids = field_offset_ids(sparse_ids(features))  # (B, 26)
 
         emb = DistributedEmbedding(
             self.vocab_capacity, self.embed_dim, hash_input=True,

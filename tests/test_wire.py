@@ -66,6 +66,83 @@ def test_bf16_pack_dtype_and_precision():
     assert float(np.abs(back - x).max() / np.abs(x).max()) < 2 ** -7
 
 
+def test_compact_wire_flag_trains_end_to_end(tmp_path):
+    """--compact_wire plumbing: a Worker with compact_wire=True parses
+    tasks through the zoo's feed_bulk_compact (counted) and the job
+    trains to completion on the compact batches."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.data.reader import TFRecordDataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_manager import (
+        TaskManager,
+        create_shards_from_ranges,
+    )
+    from elasticdl_tpu.proto.service import InProcessMasterClient
+    from elasticdl_tpu.worker.worker import Worker
+    from model_zoo.deepfm.data import write_dataset
+
+    train_dir, _ = write_dataset(
+        str(tmp_path), n_train=512, n_val=64, shards=1
+    )
+    spec = get_model_spec(
+        "model_zoo", "deepfm.deepfm_functional_api.custom_model",
+        model_params="vocab_capacity=4096;embed_dim=4",
+    )
+    compact_calls = []
+    orig = spec.feed_bulk_compact
+    spec.feed_bulk_compact = lambda *a, **k: (
+        compact_calls.append(1) or orig(*a, **k)
+    )
+    reader = TFRecordDataReader(train_dir)
+    tm = TaskManager(
+        training_shards=create_shards_from_ranges(
+            reader.create_shards(), records_per_task=128
+        ),
+        num_epochs=1,
+    )
+    servicer = MasterServicer(tm)
+    worker = Worker(
+        worker_id=0,
+        master_client=InProcessMasterClient(servicer),
+        data_reader=reader,
+        spec=spec,
+        minibatch_size=64,
+        compact_wire=True,
+    )
+    worker.run()
+    assert tm.finished
+    assert compact_calls, "feed_bulk_compact never used"
+    assert tm.counters.records_done == 512
+
+
+def test_bert_compact_feed_roundtrip():
+    """BERT's compact feed (uint16 ids): same predictions as the full
+    feed, half the id bytes, and the uint16 bound enforced."""
+    from model_zoo.bert import bert_finetune as zoo
+
+    rng = np.random.RandomState(4)
+    n, max_len = 32, 16
+    ids = rng.randint(0, 8192, size=(n, max_len)).astype(np.int32)
+    labels = rng.randint(0, 2, n)
+    buf = b"".join(
+        ids[i].tobytes() + bytes([int(labels[i])]) for i in range(n)
+    )
+    sizes = np.full(n, max_len * 4 + 1, np.int64)
+    full = zoo.feed_bulk(buf, sizes)
+    compact = zoo.feed_bulk_compact(buf, sizes)
+    assert compact["features"]["input_ids"].dtype == np.uint16
+    assert compact["labels"].dtype == np.uint8
+    np.testing.assert_array_equal(
+        compact["features"]["input_ids"].astype(np.int32),
+        full["features"]["input_ids"],
+    )
+    # ids past uint16 are rejected, not silently wrapped
+    big = np.full((1, max_len), 70000, np.int32)
+    bad_buf = big.tobytes() + bytes([0])
+    with pytest.raises(ValueError):
+        zoo.feed_bulk_compact(bad_buf, np.array([max_len * 4 + 1]))
+
+
 def test_deepfm_compact_feed_matches_full():
     """feed_bulk_compact must cut the wire bytes and leave predictions
     within bf16 rounding of the full-width feed (same params)."""
