@@ -93,6 +93,7 @@ def test_worker_tail_uses_single_step(monkeypatch):
 
     worker = Worker.__new__(Worker)
     worker.steps_per_execution = 4
+    worker.compact_wire = False
     worker._owner = owner
     worker._data_service = OneTaskService(_batches(k=6))
     worker.minibatch_size = 16
