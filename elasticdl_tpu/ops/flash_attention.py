@@ -250,13 +250,18 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # Per-program K/V VMEM residency ceiling: the (B, L, H*D)-layout kernel
-# holds a WHOLE (k_len, H*D) K and V block per program (H-fold more than
-# gen-1's per-head blocks), so very long local sequences at wide head
-# counts stop fitting VMEM.  8M elements = 16 MB bf16 per block (~64 MB
-# with V and double buffering, of the ~128 MB VMEM) compiles
-# comfortably; beyond it callers fall back to the fused-lax ring body,
-# which handles any length.
-_MAX_KV_BLOCK_ELEMENTS = 8 * 1024 * 1024
+# holds a WHOLE (k_len, H*D) K and V block per program, and the BINDING
+# limit is the 16 MB *scoped* VMEM window.  The boundary is EMPIRICAL,
+# not a clean K/V-bytes formula — the scope also charges the Q/out
+# block pipeline and f32 scratch: measured on v5e, BERT-base at L=2048
+# (k_len*H*D = 1.57M elements) overflows the scope by 8 KB while
+# L=1024 (0.79M) compiles with room.  1.25M keeps L=1024-class shapes
+# on the kernel with margin below the measured failure; beyond it
+# callers fall back to the fused-lax ring body, and truly long context
+# belongs to the ring tier (sequence sharded over chips) regardless.
+# Re-derive by measurement, not arithmetic, if the scope or kernel
+# layout changes.
+_MAX_KV_BLOCK_ELEMENTS = 5 * 256 * 1024  # 1.25M
 
 
 def flash_shapes_ok(q_shape, k_shape) -> bool:

@@ -69,3 +69,59 @@ def test_bert_ring_attention_learns_long_range(pairs_data):
     # token embedding sharded over model axis
     table = worker.state.params["params"]["token_embedding"]["embedding"]
     assert table.addressable_shards[0].data.shape[0] == table.shape[0] // 2
+
+
+def test_remat_matches_nonremat_and_shares_param_tree():
+    """`remat=True` (jax.checkpoint per encoder block) must change peak
+    memory, not math or the param tree: same init params, same loss
+    trajectory as the plain model (so checkpoints move freely between
+    remat and non-remat configs — the long-context memory knob is free
+    to toggle mid-job)."""
+    import jax
+    import numpy as np
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    params = (
+        "hidden=32;num_layers=2;heads=2;mlp_dim=64;max_len=16;"
+        "vocab_size=32"
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "input_ids": rng.randint(0, 32, size=(8, 16)).astype(np.int32)
+        },
+        "labels": rng.randint(0, 2, 8).astype(np.int32),
+    }
+
+    losses = {}
+    states = {}
+    for tag, extra in (("plain", ""), ("remat", ";remat=True")):
+        spec = get_model_spec(
+            "model_zoo", "bert.bert_finetune.custom_model",
+            model_params=params + extra,
+        )
+        trainer = Trainer(
+            model=spec.model, optimizer=spec.optimizer,
+            loss_fn=spec.loss, param_sharding_fn=spec.param_sharding,
+        )
+        state = trainer.init_state(
+            jax.random.PRNGKey(0), batch["features"]
+        )
+        run = []
+        for _ in range(3):
+            state, loss = trainer.train_on_batch(state, batch)
+            run.append(float(loss))
+        losses[tag] = run
+        states[tag] = state
+
+    # identical param trees (paths AND shapes)
+    flat_a = jax.tree_util.tree_flatten_with_path(states["plain"].params)[0]
+    flat_b = jax.tree_util.tree_flatten_with_path(states["remat"].params)[0]
+    assert [p for p, _ in flat_a] == [p for p, _ in flat_b]
+    assert [v.shape for _, v in flat_a] == [v.shape for _, v in flat_b]
+    # identical training trajectory (same math, recomputed backward)
+    np.testing.assert_allclose(
+        losses["plain"], losses["remat"], rtol=1e-5
+    )
