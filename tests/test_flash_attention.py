@@ -98,3 +98,18 @@ def test_ring_entry_preserves_sharding_when_seq_unsharded():
     assert out.sharding.is_equivalent_to(sharding, out.ndim), out.sharding
     ref = full_attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_shapes_ok_bounds():
+    """Dispatch predicate: tile rules AND the empirical K/V scoped-VMEM
+    ceiling (k_len*H*D <= 1.25M — BERT-base L=2048 measured overflowing
+    the 16MB scope; L=1024 fits)."""
+    from elasticdl_tpu.ops.flash_attention import flash_shapes_ok
+
+    ok = flash_shapes_ok
+    assert ok((64, 512, 12, 64), (64, 512, 12, 64))
+    assert ok((32, 1024, 12, 64), (32, 1024, 12, 64))      # 0.79M
+    assert not ok((16, 2048, 12, 64), (16, 2048, 12, 64))  # 1.57M
+    assert not ok((8, 520, 4, 64), (8, 520, 4, 64))        # L % 128
+    assert not ok((8, 512, 4, 256), (8, 512, 4, 256))      # D > 128
+    assert ok((8, 64, 4, 64), (8, 64, 4, 64))              # sub-128 L
