@@ -114,6 +114,14 @@ def add_common_params(parser: argparse.ArgumentParser):
         "coordinator address",
     )
     parser.add_argument(
+        "--rpc_retry_budget_s", type=float, default=0.0,
+        help="Max elapsed seconds of backed-off retries any single "
+        "control-plane RPC may consume before the worker gives up and "
+        "exits with code 45 (charged relaunch).  0 defers to the "
+        "ELASTICDL_RPC_MAX_ELAPSED_S env var, default 120 "
+        "(docs/ROBUSTNESS.md).",
+    )
+    parser.add_argument(
         "--compilation_cache_dir", default="",
         help="Persistent XLA-executable cache directory.  A relaunched "
         "worker then LOADS the train-step executable instead of "

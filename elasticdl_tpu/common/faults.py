@@ -1,0 +1,321 @@
+"""Deterministic, seeded fault injection for the elastic control plane.
+
+The north-star elasticity claims (BASELINE.md: survive >= 2 preemptions;
+ROADMAP.md: recovery time is the headline metric) are only *provable* when
+failures happen on a schedule the test controls.  This module is that
+schedule: a process-wide registry of named injection points that the
+control plane calls `fire()` on, and a seed-driven plan deciding, per
+point and per hit index, whether to raise, delay, or drop.
+
+Design constraints:
+
+- **Deterministic trace.**  The plan is a pure function of the seed, and a
+  firing is identified by (point, hit_index, action) — never by wall
+  clock.  Two runs with the same seed and the same workload therefore emit
+  byte-identical `trace_text()` output no matter how threads interleave,
+  as long as every scheduled fault actually fires (`all_fired()`), which
+  the chaos soak asserts before comparing traces.
+- **Zero cost when disabled.**  Production code calls the module-level
+  `fire(point)`, which is a single attribute read + None check when no
+  registry is installed.
+- **No dependencies.**  Importable from anywhere (proto glue, k8s client,
+  Orbax wrapper) without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Canonical injection points.  Adding one is cheap; each names the
+# boundary it guards, not the module that hosts it.
+POINT_RPC_GET_TASK = "rpc.get_task"
+POINT_RPC_REPORT = "rpc.report"
+POINT_RENDEZVOUS_JOIN = "rendezvous.join"
+POINT_CHECKPOINT_WRITE = "checkpoint.write"
+POINT_WORKER_HEARTBEAT = "worker.heartbeat"
+POINT_POD_WATCH = "pod.watch"
+
+POINTS = (
+    POINT_RPC_GET_TASK,
+    POINT_RPC_REPORT,
+    POINT_RENDEZVOUS_JOIN,
+    POINT_CHECKPOINT_WRITE,
+    POINT_WORKER_HEARTBEAT,
+    POINT_POD_WATCH,
+)
+
+ACTIONS = ("raise", "delay", "drop")
+
+# Env wire format for subprocess workers (ProcessK8sClient pods): the
+# parent serializes its registry's plan; `configure_from_env()` rebuilds
+# an identical one in the child.
+ENV_SCHEDULE = "ELASTICDL_FAULT_SCHEDULE"
+ENV_SEED = "ELASTICDL_FAULT_SEED"
+
+
+class InjectedFault(Exception):
+    """An injected failure (the `raise` action).  Classified as retryable
+    by resilience.is_retryable_error — injected faults model transient
+    infrastructure errors."""
+
+
+class DroppedRequest(InjectedFault):
+    """An injected drop: the request/event is lost in flight.  At RPC
+    sites this surfaces as an error (the caller cannot tell a dropped
+    request from a failed one); at event sites the caller swallows it and
+    skips delivery."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: at the `at`-th hit of `point`, do `action`."""
+
+    point: str
+    at: int
+    action: str  # "raise" | "delay" | "drop"
+    delay_s: float = 0.0
+
+    def key(self) -> Tuple[str, int]:
+        return (self.point, self.at)
+
+    def describe(self) -> str:
+        extra = f" delay={self.delay_s:.3f}s" if self.action == "delay" else ""
+        return f"{self.point}#{self.at} {self.action}{extra}"
+
+
+class FaultRegistry:
+    """Seeded fault plan + thread-safe hit counting + canonical trace."""
+
+    def __init__(
+        self,
+        schedule: Iterable[FaultSpec] = (),
+        seed: Optional[int] = None,
+    ):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._plan: Dict[str, Dict[int, FaultSpec]] = {}
+        for spec in schedule:
+            if spec.action not in ACTIONS:
+                raise ValueError(f"unknown fault action {spec.action!r}")
+            self._plan.setdefault(spec.point, {})[spec.at] = spec
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[Tuple[str, int], FaultSpec] = {}
+        self._notes: Dict[str, List[str]] = {}
+
+    # ---- construction ---------------------------------------------------
+
+    @classmethod
+    def from_seed(
+        cls,
+        seed: int,
+        points: Iterable[str] = POINTS,
+        faults_per_point: int = 2,
+        max_hit: int = 8,
+        actions: Iterable[str] = ACTIONS,
+    ) -> "FaultRegistry":
+        """Derive a schedule purely from `seed`: for each point (in the
+        given, fixed order) pick `faults_per_point` distinct hit indices
+        below `max_hit` and an action for each.  Same seed => same plan,
+        on any host."""
+        import random
+
+        rng = random.Random(seed)
+        actions = tuple(actions)
+        schedule = []
+        for point in points:
+            for at in sorted(rng.sample(range(max_hit), faults_per_point)):
+                action = rng.choice(actions)
+                delay = (
+                    round(rng.uniform(0.01, 0.05), 3)
+                    if action == "delay"
+                    else 0.0
+                )
+                schedule.append(FaultSpec(point, at, action, delay))
+        return cls(schedule, seed=seed)
+
+    # ---- the hot path ---------------------------------------------------
+
+    def fire(self, point: str) -> None:
+        """Count one hit of `point` and execute any fault scheduled at
+        this hit index.  Raises InjectedFault/DroppedRequest for the
+        raise/drop actions; sleeps for delay; no-op otherwise."""
+        with self._lock:
+            hit = self._hits.get(point, 0)
+            self._hits[point] = hit + 1
+            spec = self._plan.get(point, {}).get(hit)
+            if spec is not None:
+                self._fired[spec.key()] = spec
+        if spec is None:
+            return
+        if spec.action == "delay":
+            time.sleep(spec.delay_s)
+            return
+        if spec.action == "drop":
+            raise DroppedRequest(f"injected drop at {spec.describe()}")
+        raise InjectedFault(f"injected failure at {spec.describe()}")
+
+    def note(self, key: str, detail: str = "") -> None:
+        """Record a test-driven chaos event (a kill, a corruption) in the
+        trace.  Keep `detail` free of run-variant data (clocks, pids) —
+        notes are part of the byte-compared trace."""
+        with self._lock:
+            self._notes.setdefault(key, []).append(detail)
+
+    # ---- introspection --------------------------------------------------
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def all_fired(self) -> bool:
+        """True when every scheduled fault has fired (the workload drove
+        each point past its highest scheduled hit index)."""
+        with self._lock:
+            planned = sum(len(v) for v in self._plan.values())
+            return len(self._fired) == planned
+
+    def unfired(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                spec.describe()
+                for by_hit in self._plan.values()
+                for spec in by_hit.values()
+                if spec.key() not in self._fired
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            by_action: Dict[str, int] = {}
+            for spec in self._fired.values():
+                by_action[spec.action] = by_action.get(spec.action, 0) + 1
+            return {
+                "planned": sum(len(v) for v in self._plan.values()),
+                "injected": len(self._fired),
+                "by_action": by_action,
+                "hits": dict(sorted(self._hits.items())),
+                "notes": sum(len(v) for v in self._notes.values()),
+            }
+
+    def trace_text(self) -> str:
+        """Canonical fault trace: plan, firings, and notes in a fixed
+        sort order with no timestamps — byte-identical across same-seed
+        runs that fired the full plan and issued the same notes."""
+        with self._lock:
+            lines = [f"fault-trace v1 seed={self.seed}"]
+            plan = sorted(
+                (spec for by_hit in self._plan.values()
+                 for spec in by_hit.values()),
+                key=lambda s: (s.point, s.at),
+            )
+            for spec in plan:
+                lines.append(f"plan {spec.describe()}")
+            for key in sorted(self._fired):
+                lines.append(f"fired {self._fired[key].describe()}")
+            for key in sorted(self._notes):
+                for i, detail in enumerate(self._notes[key]):
+                    suffix = f" {detail}" if detail else ""
+                    lines.append(f"note {key}#{i}{suffix}")
+        return "\n".join(lines) + "\n"
+
+    # ---- (de)serialization ---------------------------------------------
+
+    def schedule_json(self) -> str:
+        with self._lock:
+            specs = sorted(
+                (spec for by_hit in self._plan.values()
+                 for spec in by_hit.values()),
+                key=lambda s: (s.point, s.at),
+            )
+            return json.dumps(
+                [
+                    {
+                        "point": s.point,
+                        "at": s.at,
+                        "action": s.action,
+                        "delay_s": s.delay_s,
+                    }
+                    for s in specs
+                ]
+            )
+
+    @classmethod
+    def from_schedule_json(
+        cls, text: str, seed: Optional[int] = None
+    ) -> "FaultRegistry":
+        schedule = [
+            FaultSpec(
+                point=str(e["point"]),
+                at=int(e["at"]),
+                action=str(e["action"]),
+                delay_s=float(e.get("delay_s", 0.0)),
+            )
+            for e in json.loads(text)
+        ]
+        return cls(schedule, seed=seed)
+
+    def env(self) -> Dict[str, str]:
+        """Env vars that reproduce this registry in a subprocess worker
+        (pair with configure_from_env)."""
+        out = {ENV_SCHEDULE: self.schedule_json()}
+        if self.seed is not None:
+            out[ENV_SEED] = str(self.seed)
+        return out
+
+
+# ---- process-wide singleton ---------------------------------------------
+
+_active: Optional[FaultRegistry] = None
+
+
+def install(registry: FaultRegistry) -> FaultRegistry:
+    global _active
+    _active = registry
+    return registry
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def get_registry() -> Optional[FaultRegistry]:
+    return _active
+
+
+def fire(point: str) -> None:
+    """Module-level hot path: no-op unless a registry is installed."""
+    registry = _active
+    if registry is not None:
+        registry.fire(point)
+
+
+def note(key: str, detail: str = "") -> None:
+    registry = _active
+    if registry is not None:
+        registry.note(key, detail)
+
+
+def configure_from_env(environ=None) -> Optional[FaultRegistry]:
+    """Install a registry described by the environment (subprocess
+    workers of a chaos run).  ELASTICDL_FAULT_SCHEDULE carries an explicit
+    plan; ELASTICDL_FAULT_SEED alone derives the default seeded plan.
+    Returns the installed registry, or None when neither is set."""
+    environ = os.environ if environ is None else environ
+    schedule = environ.get(ENV_SCHEDULE, "")
+    seed_text = environ.get(ENV_SEED, "")
+    seed = int(seed_text) if seed_text else None
+    if schedule:
+        return install(FaultRegistry.from_schedule_json(schedule, seed=seed))
+    if seed is not None:
+        return install(FaultRegistry.from_seed(seed))
+    return None
+
+
+def stats() -> dict:
+    registry = _active
+    return registry.stats() if registry is not None else {}

@@ -1,0 +1,319 @@
+"""One retry policy for the whole control plane.
+
+Before this module, every RPC call site hand-rolled its own loop: the
+SPMD dispatch loop slept a fixed interval forever, the channel wait was a
+bare 60s `channel_ready_future`, the pod manager retried a delete exactly
+once.  This module replaces all of them with a single `RetryPolicy`
+(exponential backoff + full jitter, per-attempt deadline, max-elapsed
+budget, pluggable retryable classification, giving-up hook) and a gRPC
+client interceptor that applies it uniformly to every stub method.
+
+Budget exhaustion is a first-class outcome: `RetryBudgetExhausted` is
+raised (never retried), and workers translate it into
+`RETRY_EXHAUSTED_EXIT_CODE` so the pod manager restarts them through the
+normal relaunch-budget path instead of leaving a zombie spinning on a
+dead master.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from elasticdl_tpu.common import faults
+
+logger = logging.getLogger(__name__)
+
+try:  # the container always has grpc, but keep the module importable
+    import grpc
+except Exception:  # pragma: no cover
+    grpc = None
+
+# Distinct from the intentional-restart codes (43 wedge, 44 topology):
+# exhausting a retry budget is a real failure and must be charged against
+# the pod's relaunch budget, not relaunched for free.
+RETRY_EXHAUSTED_EXIT_CODE = 45
+
+# Env knobs (see docs/ROBUSTNESS.md); CLI flags in common/args.py override.
+ENV_MAX_ELAPSED_S = "ELASTICDL_RPC_MAX_ELAPSED_S"
+ENV_INITIAL_BACKOFF_S = "ELASTICDL_RPC_INITIAL_BACKOFF_S"
+ENV_MAX_BACKOFF_S = "ELASTICDL_RPC_MAX_BACKOFF_S"
+ENV_ATTEMPT_TIMEOUT_S = "ELASTICDL_RPC_ATTEMPT_TIMEOUT_S"
+
+_RETRYABLE_GRPC_CODES = None
+
+
+class RetryBudgetExhausted(Exception):
+    """A call gave up: every attempt failed and the elapsed/attempt budget
+    ran out.  Carries the last underlying error as __cause__."""
+
+    def __init__(self, description: str, attempts: int, elapsed_s: float,
+                 last_error: Optional[BaseException] = None):
+        self.description = description
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.last_error = last_error
+        super().__init__(
+            f"{description or 'call'}: gave up after {attempts} attempts "
+            f"({elapsed_s:.1f}s elapsed): {last_error!r}"
+        )
+
+
+def _retryable_grpc_codes():
+    global _RETRYABLE_GRPC_CODES
+    if _RETRYABLE_GRPC_CODES is None and grpc is not None:
+        _RETRYABLE_GRPC_CODES = frozenset({
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+            grpc.StatusCode.RESOURCE_EXHAUSTED,
+            grpc.StatusCode.ABORTED,
+            grpc.StatusCode.UNKNOWN,
+        })
+    return _RETRYABLE_GRPC_CODES or frozenset()
+
+
+def is_retryable_error(exc: BaseException) -> bool:
+    """Default classification: transient infrastructure errors retry,
+    application errors and exhausted budgets do not."""
+    if isinstance(exc, RetryBudgetExhausted):
+        return False
+    if isinstance(exc, faults.InjectedFault):
+        return True
+    if isinstance(exc, ConnectionError):
+        return True
+    if grpc is not None:
+        if isinstance(exc, grpc.FutureTimeoutError):
+            return True
+        if isinstance(exc, grpc.RpcError):
+            try:
+                code = exc.code()
+            except Exception:
+                return True  # malformed RpcError: assume transient
+            return code in _retryable_grpc_codes()
+    return False
+
+
+# ---- process-wide counters (exported via master/worker snapshots) --------
+
+_stats_lock = threading.Lock()
+_retries: "collections.Counter[str]" = collections.Counter()
+_giveups: "collections.Counter[str]" = collections.Counter()
+
+
+def _record_retry(description: str) -> None:
+    with _stats_lock:
+        _retries[description or "?"] += 1
+
+
+def _record_giveup(description: str) -> None:
+    with _stats_lock:
+        _giveups[description or "?"] += 1
+
+
+def stats() -> dict:
+    with _stats_lock:
+        return {
+            "retries": sum(_retries.values()),
+            "giveups": sum(_giveups.values()),
+            "retries_by_call": dict(sorted(_retries.items())),
+            "giveups_by_call": dict(sorted(_giveups.items())),
+        }
+
+
+def reset_stats() -> None:
+    with _stats_lock:
+        _retries.clear()
+        _giveups.clear()
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter, bounded by wall-clock budget
+    and/or attempt count.
+
+    `call(fn)` retries `fn()` while `retryable(exc)` holds and budget
+    remains.  Only `Exception` is caught — BaseException control flow
+    (PreemptedError, KeyboardInterrupt, SystemExit) always propagates.
+    """
+
+    def __init__(
+        self,
+        initial_backoff_s: float = 0.1,
+        max_backoff_s: float = 5.0,
+        multiplier: float = 2.0,
+        attempt_timeout_s: Optional[float] = None,
+        max_elapsed_s: Optional[float] = 60.0,
+        max_attempts: int = 0,  # 0 = unbounded by count
+        retryable: Callable[[BaseException], bool] = is_retryable_error,
+        on_give_up: Optional[Callable[..., None]] = None,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.initial_backoff_s = initial_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.multiplier = multiplier
+        self.attempt_timeout_s = attempt_timeout_s
+        self.max_elapsed_s = max_elapsed_s
+        self.max_attempts = max_attempts
+        self.retryable = retryable
+        self.on_give_up = on_give_up
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._clock = clock
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full jitter: uniform in [0, min(cap, initial * mult^attempt)]."""
+        ceiling = min(
+            self.max_backoff_s,
+            self.initial_backoff_s * (self.multiplier ** attempt),
+        )
+        return self._rng.uniform(0.0, ceiling)
+
+    def with_overrides(self, **kw) -> "RetryPolicy":
+        fields = dict(
+            initial_backoff_s=self.initial_backoff_s,
+            max_backoff_s=self.max_backoff_s,
+            multiplier=self.multiplier,
+            attempt_timeout_s=self.attempt_timeout_s,
+            max_elapsed_s=self.max_elapsed_s,
+            max_attempts=self.max_attempts,
+            retryable=self.retryable,
+            on_give_up=self.on_give_up,
+        )
+        fields.update(kw)
+        return RetryPolicy(
+            sleep=self._sleep, clock=self._clock, rng=self._rng, **fields
+        )
+
+    def call(self, fn: Callable[[], object], description: str = ""):
+        start = self._clock()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as exc:
+                if not self.retryable(exc):
+                    raise
+                attempt += 1
+                elapsed = self._clock() - start
+                delay = self.backoff_s(attempt - 1)
+                out_of_attempts = (
+                    self.max_attempts > 0 and attempt >= self.max_attempts
+                )
+                out_of_time = (
+                    self.max_elapsed_s is not None
+                    and elapsed + delay >= self.max_elapsed_s
+                )
+                if out_of_attempts or out_of_time:
+                    _record_giveup(description)
+                    if self.on_give_up is not None:
+                        try:
+                            self.on_give_up(description, attempt, elapsed, exc)
+                        except Exception:
+                            logger.exception("on_give_up hook failed")
+                    raise RetryBudgetExhausted(
+                        description, attempt, elapsed, exc
+                    ) from exc
+                _record_retry(description)
+                logger.warning(
+                    "%s failed (attempt %d, %.1fs elapsed): %r; "
+                    "retrying in %.2fs",
+                    description or "call", attempt, elapsed, exc, delay,
+                )
+                self._sleep(delay)
+
+
+def default_policy(**overrides) -> RetryPolicy:
+    """A policy with env-tunable defaults (docs/ROBUSTNESS.md)."""
+    def _env_f(name, default):
+        raw = os.environ.get(name, "")
+        try:
+            return float(raw) if raw else default
+        except ValueError:
+            return default
+
+    kw = dict(
+        initial_backoff_s=_env_f(ENV_INITIAL_BACKOFF_S, 0.1),
+        max_backoff_s=_env_f(ENV_MAX_BACKOFF_S, 5.0),
+        max_elapsed_s=_env_f(ENV_MAX_ELAPSED_S, 120.0),
+        attempt_timeout_s=_env_f(ENV_ATTEMPT_TIMEOUT_S, 20.0),
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def wait_for_channel_ready(channel, policy: RetryPolicy,
+                           description: str = "channel_ready") -> None:
+    """Replace the bare `channel_ready_future(...).result(timeout=60)`:
+    per-attempt timeout + policy budget, RetryBudgetExhausted on a master
+    that never comes up."""
+    attempt_timeout = policy.attempt_timeout_s or 5.0
+
+    def _wait():
+        grpc.channel_ready_future(channel).result(timeout=attempt_timeout)
+
+    policy.call(_wait, description=description)
+
+
+# ---- gRPC client interceptor ---------------------------------------------
+
+if grpc is not None:
+
+    class _ClientCallDetails(
+        collections.namedtuple(
+            "_ClientCallDetails",
+            ("method", "timeout", "metadata", "credentials",
+             "wait_for_ready", "compression"),
+        ),
+        grpc.ClientCallDetails,
+    ):
+        pass
+
+    class RetryingClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+        """Applies a RetryPolicy to every unary-unary call on a channel,
+        and fires the method's fault-injection point on each attempt so
+        chaos runs exercise the real network stub path too."""
+
+        def __init__(self, policy: RetryPolicy,
+                     fault_points: Optional[dict] = None):
+            self._policy = policy
+            # method path -> faults.POINT_*; late import avoids a cycle
+            self._fault_points = dict(fault_points or {})
+
+        def intercept_unary_unary(self, continuation, client_call_details,
+                                  request):
+            method = client_call_details.method
+            point = self._fault_points.get(method)
+            details = client_call_details
+            if self._policy.attempt_timeout_s is not None:
+                details = _ClientCallDetails(
+                    method=client_call_details.method,
+                    timeout=self._policy.attempt_timeout_s,
+                    metadata=getattr(client_call_details, "metadata", None),
+                    credentials=getattr(
+                        client_call_details, "credentials", None),
+                    wait_for_ready=getattr(
+                        client_call_details, "wait_for_ready", None),
+                    compression=getattr(
+                        client_call_details, "compression", None),
+                )
+
+            def _attempt():
+                if point is not None:
+                    faults.fire(point)
+                outcome = continuation(details, request)
+                outcome.result()  # materialize so errors hit the policy
+                return outcome
+
+            return self._policy.call(_attempt, description=str(method))
+
+else:  # pragma: no cover
+
+    class RetryingClientInterceptor:  # type: ignore[no-redef]
+        def __init__(self, *a, **kw):
+            raise RuntimeError("grpcio is not available")

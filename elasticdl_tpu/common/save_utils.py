@@ -10,12 +10,36 @@ save-on-signal hooks into the pod manager instead of the PS.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
+
+
+def _file_digest(path: str) -> Dict[str, Any]:
+    sha = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha.update(chunk)
+            size += len(chunk)
+    return {"sha256": sha.hexdigest(), "size": size}
+
+
+def _step_files(step_dir: str):
+    """Relative paths of every regular file under a step directory, in a
+    stable order."""
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            out.append(os.path.relpath(full, step_dir))
+    return sorted(out)
 
 
 def _swap_tree_keys(node, old: str, new: str):
@@ -81,6 +105,11 @@ class CheckpointSaver:
 
         self._dir = os.path.abspath(checkpoint_dir)
         os.makedirs(self._dir, exist_ok=True)
+        # Per-step checksum manifests live in a side directory (never
+        # inside the step dir: Orbax owns that layout) so restores can
+        # detect truncated/corrupted checkpoints and fall back.
+        self._manifest_dir = os.path.join(self._dir, ".manifests")
+        os.makedirs(self._manifest_dir, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -92,13 +121,105 @@ class CheckpointSaver:
     def save(self, state, force: bool = False) -> bool:
         import orbax.checkpoint as ocp
 
+        try:
+            faults.fire(faults.POINT_CHECKPOINT_WRITE)
+        except faults.InjectedFault as exc:
+            # A failed periodic save is survivable by design: the next
+            # crossing saves again, and restores fall back to the last
+            # committed step.  Only injected faults take this path — real
+            # Orbax errors still propagate.
+            logger.warning("checkpoint save skipped (%s)", exc)
+            return False
         step = int(state.step)
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved:
             logger.info("Checkpoint saved at step %d", step)
+        # Manifests cover FINALIZED steps only (async saves commit
+        # later); anything committed by now — including earlier async
+        # saves — gets its manifest here.
+        self._refresh_manifests()
         return saved
+
+    # ---- integrity manifests -------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"{step}.json")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
+    def _refresh_manifests(self) -> None:
+        """Write missing manifests for finalized steps and prune manifests
+        of rotated-away steps.  Best-effort: integrity metadata must never
+        fail a save."""
+        try:
+            steps = set(self._mngr.all_steps())
+            for step in steps:
+                path = self._manifest_path(step)
+                if os.path.exists(path):
+                    continue
+                self._write_manifest(step)
+            for name in os.listdir(self._manifest_dir):
+                stem, ext = os.path.splitext(name)
+                if ext == ".json" and stem.isdigit() \
+                        and int(stem) not in steps:
+                    os.remove(os.path.join(self._manifest_dir, name))
+        except Exception:
+            logger.exception("checkpoint manifest refresh failed")
+
+    def _write_manifest(self, step: int) -> None:
+        step_dir = self._step_dir(step)
+        if not os.path.isdir(step_dir):
+            return
+        manifest = {
+            "step": step,
+            "files": {
+                rel: _file_digest(os.path.join(step_dir, rel))
+                for rel in _step_files(step_dir)
+            },
+        }
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        # temp file + os.replace: readers only ever see a complete
+        # manifest, even across a crash mid-write
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def verify_step(self, step: int) -> bool:
+        """Check a step's files against its manifest.  True when intact
+        or when no manifest exists (pre-manifest checkpoints stay
+        restorable); False on any missing/truncated/altered file."""
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return True
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return True  # unreadable manifest != corrupt checkpoint
+        step_dir = self._step_dir(step)
+        for rel, want in manifest.get("files", {}).items():
+            full = os.path.join(step_dir, rel)
+            if not os.path.isfile(full):
+                logger.warning(
+                    "checkpoint step %d: missing file %s", step, rel
+                )
+                return False
+            got = _file_digest(full)
+            if got["size"] != want.get("size") \
+                    or got["sha256"] != want.get("sha256"):
+                logger.warning(
+                    "checkpoint step %d: checksum mismatch in %s "
+                    "(%d bytes vs %d expected)",
+                    step, rel, got["size"], want.get("size", -1),
+                )
+                return False
+        return True
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -114,6 +235,12 @@ class CheckpointSaver:
         import orbax.checkpoint as ocp
 
         if step not in self._mngr.all_steps():
+            return None
+        if not self.verify_step(step):
+            logger.warning(
+                "checkpoint step %d failed integrity check; not restoring",
+                step,
+            )
             return None
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(
@@ -170,13 +297,19 @@ class CheckpointSaver:
             return _swap_tree_keys(restored, "stack", "gpipe_stack")
 
     def maybe_restore(self, template: Any) -> Optional[Any]:
-        """Restore the newest checkpoint into the sharding/structure of
-        `template` (an abstract or concrete train state)."""
-        import jax
-        import orbax.checkpoint as ocp
+        """Restore the newest INTACT checkpoint into the sharding/
+        structure of `template` (an abstract or concrete train state).
 
-        step = self._mngr.latest_step()
-        if step is None:
+        A latest step that is truncated/corrupt (manifest mismatch) or
+        fails to restore falls back to the previous good step — a torn
+        write must cost one checkpoint interval of progress, never the
+        job.  When every step fails to restore, the last restore error
+        re-raises (callers must not silently train from scratch when
+        checkpoints exist but are all broken)."""
+        import jax
+
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
             return None
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(
@@ -186,12 +319,33 @@ class CheckpointSaver:
             else x,
             template,
         )
-        restored = self._restore_with_shims(step, abstract)
-        logger.info("Restored checkpoint step %d", step)
-        return restored
+        last_exc: Optional[Exception] = None
+        for step in steps:
+            if not self.verify_step(step):
+                logger.warning(
+                    "checkpoint step %d corrupt; falling back to the "
+                    "previous good step", step,
+                )
+                continue
+            try:
+                restored = self._restore_with_shims(step, abstract)
+            except Exception as exc:
+                last_exc = exc
+                logger.warning(
+                    "checkpoint step %d failed to restore (%s); falling "
+                    "back to the previous good step", step, exc,
+                )
+                continue
+            logger.info("Restored checkpoint step %d", step)
+            return restored
+        if last_exc is not None:
+            raise last_exc
+        return None
 
     def wait_until_finished(self):
         self._mngr.wait_until_finished()
+        # async saves finalized by now become manifest-covered
+        self._refresh_manifests()
 
     def close(self):
         self._mngr.close()

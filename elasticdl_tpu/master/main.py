@@ -358,6 +358,21 @@ class Master:
                         {w: round(s, 1) for w, s in stale.items()},
                     )
 
+    def snapshot(self) -> dict:
+        """One observability surface for chaos runs and job-end logging:
+        task progress, recovery durations, pod churn, and the process-wide
+        fault/retry counters."""
+        from elasticdl_tpu.common import faults, resilience
+
+        out = {"tasks": self.task_manager.snapshot()}
+        if self.recovery_clock is not None:
+            out["recovery"] = self.recovery_clock.snapshot()
+        if self.pod_manager is not None:
+            out["pods"] = self.pod_manager.snapshot()
+        out["resilience"] = resilience.stats()
+        out["faults"] = faults.stats()
+        return out
+
     def stop(self):
         if self.pod_manager is not None:
             self.pod_manager.stop()
@@ -391,10 +406,15 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
             k8s_client = K8sClient(
                 namespace=args.namespace, job_name=args.job_name
             )
+    # chaos runs configure the master's fault schedule via the
+    # environment, same wire as subprocess workers; no-op otherwise
+    from elasticdl_tpu.common import faults
+
+    faults.configure_from_env()
     master = Master(args, k8s_client=k8s_client)
     master.start()
     ok = master.wait()
-    logger.info("Job complete: %s", master.task_manager.snapshot())
+    logger.info("Job complete: %s", master.snapshot())
     if master.recovery_clock is not None and master.recovery_clock.history:
         logger.info(
             "Elastic recoveries this job: %s",

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from elasticdl_tpu.common import faults, resilience
 from elasticdl_tpu.common.constants import PodStatus, PodType
 from elasticdl_tpu.common.k8s_client import AbstractK8sClient, PodSpec
 from elasticdl_tpu.common.log_utils import get_logger
@@ -84,6 +85,19 @@ class PodManager:
         self._relaunch_count: Dict[int, int] = {}
         self._phases: Dict[str, str] = {}
         self.stopped = False
+        # chaos-run observability (master snapshot())
+        self._losses_seen = 0
+        self._relaunches = 0
+        # Shared resilience policy for apiserver deletes (was a bespoke
+        # single-retry loop): NotFound is terminal, anything else gets one
+        # backed-off retry before we fall back to the wedge watchdog.
+        self._delete_policy = resilience.RetryPolicy(
+            initial_backoff_s=0.1,
+            max_backoff_s=1.0,
+            max_elapsed_s=None,
+            max_attempts=2,
+            retryable=lambda exc: not _is_not_found(exc),
+        )
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -259,6 +273,15 @@ class PodManager:
 
     def _event_cb(self, pod_name: str, phase: str, address: str = "",
                   exit_code=None):
+        try:
+            faults.fire(faults.POINT_POD_WATCH)
+        except faults.InjectedFault as exc:
+            # A dropped/failed watch delivery: real watches miss events
+            # too; the next status event (or pod relist) re-converges.
+            logger.warning(
+                "pod watch event for %s dropped (%s)", pod_name, exc
+            )
+            return
         worker_id = self._worker_by_pod.get(pod_name)
         if worker_id is None:
             return
@@ -290,6 +313,8 @@ class PodManager:
                         exit_code=None):
         if self._recovery_clock is not None and not self.stopped:
             self._recovery_clock.mark_loss()
+        with self._lock:
+            self._losses_seen += 1
         # 1. failure detector -> task lease recovery (at-least-once)
         if self._tm is not None:
             self._tm.recover_tasks(worker_id)
@@ -348,6 +373,8 @@ class PodManager:
             if not intentional:
                 self._restart_group_peers(group, lost_worker=worker_id)
             # the replacement joins the lost worker's slice group
+            with self._lock:
+                self._relaunches += 1
             self._launch_worker(new_id, group=group)
         elif none_alive:
             self._on_job_abort(
@@ -378,37 +405,42 @@ class PodManager:
                 "Group %d restart: deleting peer worker %d (%s) of "
                 "failed worker %d", group, w, pod, lost_worker,
             )
-            # One retry on transient apiserver errors before giving up:
-            # dropping the budget-free marker on a transient failure
-            # would leave the wedged peer waiting out its full
-            # wedge-watchdog grace (ADVICE r3).  NotFound means the peer
-            # is already gone (its own watchdog beat us) — fine, its
-            # FAILED event relaunches via the intentional-exit path.
-            for attempt in (0, 1):
-                try:
-                    self._k8s.delete_pod(pod)
-                    break
-                except Exception as exc:
-                    if _is_not_found(exc):
-                        with self._lock:
-                            self._group_restart_pods.discard(pod)
-                        break
-                    if attempt == 0:
-                        logger.warning(
-                            "Group %d restart: transient delete failure "
-                            "for %s (%s); retrying once", group, pod, exc,
-                        )
-                        continue
-                    logger.warning(
-                        "Group %d restart: could not delete peer %s "
-                        "(%s); it will recover via its wedge watchdog",
-                        group, pod, exc,
-                    )
-                    with self._lock:
-                        self._group_restart_pods.discard(pod)
+            # Shared resilience policy (was a bespoke single-retry loop):
+            # transient apiserver errors get one backed-off retry — losing
+            # the budget-free marker on a transient failure would leave
+            # the wedged peer waiting out its full wedge-watchdog grace
+            # (ADVICE r3).  NotFound means the peer is already gone (its
+            # own watchdog beat us) — fine, its FAILED event relaunches
+            # via the intentional-exit path.
+            try:
+                self._delete_policy.call(
+                    lambda: self._k8s.delete_pod(pod),
+                    description="delete_pod",
+                )
+            except resilience.RetryBudgetExhausted as exc:
+                logger.warning(
+                    "Group %d restart: could not delete peer %s "
+                    "(%s); it will recover via its wedge watchdog",
+                    group, pod, exc,
+                )
+                with self._lock:
+                    self._group_restart_pods.discard(pod)
+            except Exception as exc:
+                if not _is_not_found(exc):
+                    raise
+                with self._lock:
+                    self._group_restart_pods.discard(pod)
 
     # ---- introspection -------------------------------------------------
 
     def alive_workers(self):
         with self._lock:
             return sorted(self._pod_by_worker)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "alive": len(self._pod_by_worker),
+                "losses_seen": self._losses_seen,
+                "relaunches": self._relaunches,
+            }

@@ -24,6 +24,7 @@ class RecoveryClock:
     def __init__(self):
         self._lock = threading.Lock()
         self._pending_since: Optional[float] = None
+        self.losses = 0
         self.history: List[float] = []
 
     def mark_loss(self) -> None:
@@ -31,6 +32,7 @@ class RecoveryClock:
         The earliest pending loss wins so a multi-loss outage is measured
         end to end."""
         with self._lock:
+            self.losses += 1
             if self._pending_since is None:
                 self._pending_since = time.time()
 
@@ -48,3 +50,12 @@ class RecoveryClock:
             "training progress)", elapsed,
         )
         return elapsed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "losses": self.losses,
+                "recoveries": len(self.history),
+                "recovery_durations_s": list(self.history),
+                "pending": self._pending_since is not None,
+            }

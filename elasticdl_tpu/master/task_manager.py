@@ -617,4 +617,8 @@ class TaskManager:
                 "num_epochs": self._num_epochs,
                 "finished": self._finished,
                 "counters": vars(self.counters).copy(),
+                # chaos-run observability: how often shards failed and
+                # re-queued (charged) vs. transiently bounced (uncharged)
+                "task_retries": sum(self._task_retry_count.values()),
+                "transient_requeues": sum(self._transient_count.values()),
             }

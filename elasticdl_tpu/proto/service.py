@@ -14,6 +14,7 @@ traffic lives on the device mesh in the TPU-native design.
 
 from __future__ import annotations
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 SERVICE_NAME = "elasticdl_tpu.Master"
@@ -28,6 +29,28 @@ MASTER_METHODS = {
     "keep_alive": (pb.KeepAliveRequest, pb.Empty),
     "report_version": (pb.ReportVersionRequest, pb.Empty),
 }
+
+# method name -> fault-injection point (common/faults.py).  Both client
+# transports fire the method's point per attempt, so a chaos schedule
+# exercises in-process tests and real-socket runs identically.
+METHOD_FAULT_POINTS = {
+    "get_task": faults.POINT_RPC_GET_TASK,
+    "get_spmd_task": faults.POINT_RPC_GET_TASK,
+    "report_task_result": faults.POINT_RPC_REPORT,
+    "report_evaluation_metrics": faults.POINT_RPC_REPORT,
+    "report_version": faults.POINT_RPC_REPORT,
+    "get_cluster_spec": faults.POINT_RENDEZVOUS_JOIN,
+    "keep_alive": faults.POINT_WORKER_HEARTBEAT,
+}
+
+
+def method_fault_point_paths() -> dict:
+    """Full-path variant ('/elasticdl_tpu.Master/get_task' -> point) for
+    the gRPC client interceptor, which only sees method paths."""
+    return {
+        f"/{SERVICE_NAME}/{name}": point
+        for name, point in METHOD_FAULT_POINTS.items()
+    }
 
 
 def add_master_servicer_to_server(servicer, server) -> None:
@@ -50,9 +73,26 @@ def add_master_servicer_to_server(servicer, server) -> None:
 class MasterStub:
     """Client stub over a grpc channel; method-for-method mirror of the
     servicer so `InProcessMasterClient` (direct servicer calls, used by the
-    tests and local mode) and this stub are interchangeable."""
+    tests and local mode) and this stub are interchangeable.
 
-    def __init__(self, channel):
+    With `retry_policy`, every method goes through the resilience
+    interceptor: per-attempt deadline, exponential backoff + full jitter,
+    max-elapsed budget, and per-attempt fault injection."""
+
+    def __init__(self, channel, retry_policy=None):
+        if retry_policy is not None:
+            import grpc
+
+            from elasticdl_tpu.common.resilience import (
+                RetryingClientInterceptor,
+            )
+
+            channel = grpc.intercept_channel(
+                channel,
+                RetryingClientInterceptor(
+                    retry_policy, fault_points=method_fault_point_paths()
+                ),
+            )
         for name, (req_cls, resp_cls) in MASTER_METHODS.items():
             callable_ = channel.unary_unary(
                 f"/{SERVICE_NAME}/{name}",
@@ -79,11 +119,22 @@ class InProcessMasterClient:
     (the reference exercises its protocol the same way in
     worker_ps_interaction_test.py — SURVEY.md §4.2)."""
 
-    def __init__(self, servicer):
+    def __init__(self, servicer, retry_policy=None):
         for name in MASTER_METHODS:
             method = getattr(servicer, name)
-            setattr(
-                self,
-                name,
-                lambda request, timeout=None, _m=method: _m(request, None),
-            )
+            point = METHOD_FAULT_POINTS.get(name)
+            call = self._make_call(method, point, retry_policy, name)
+            setattr(self, name, call)
+
+    @staticmethod
+    def _make_call(method, point, retry_policy, name):
+        def _attempt(request):
+            if point is not None:
+                faults.fire(point)
+            return method(request, None)
+
+        if retry_policy is None:
+            return lambda request, timeout=None: _attempt(request)
+        return lambda request, timeout=None: retry_policy.call(
+            lambda: _attempt(request), description=name
+        )

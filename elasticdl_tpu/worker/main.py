@@ -21,11 +21,16 @@ from elasticdl_tpu.data.reader import create_data_reader
 logger = get_logger(__name__)
 
 
-def build_master_client(addr: str):
+def build_master_client(addr: str, retry_policy=None):
     import grpc
 
+    from elasticdl_tpu.common.resilience import (
+        default_policy,
+        wait_for_channel_ready,
+    )
     from elasticdl_tpu.proto.service import MasterStub
 
+    policy = retry_policy if retry_policy is not None else default_policy()
     channel = grpc.insecure_channel(
         addr,
         options=[
@@ -33,8 +38,11 @@ def build_master_client(addr: str):
             ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_LENGTH),
         ],
     )
-    grpc.channel_ready_future(channel).result(timeout=60)
-    return MasterStub(channel)
+    # Bounded, jittered wait instead of a bare 60s block: a master that
+    # never comes up turns into RetryBudgetExhausted -> exit code 45, a
+    # charged relaunch, rather than an opaque hang-then-crash.
+    wait_for_channel_ready(channel, policy)
+    return MasterStub(channel, retry_policy=policy)
 
 
 def start_keep_alive(client, worker_id: int, master_addr: str) -> str:
@@ -85,6 +93,29 @@ def wait_for_membership(client, worker_id: int, poll_s: float = 0.5):
 
 
 def main(argv=None):
+    import sys
+
+    from elasticdl_tpu.common import faults
+    from elasticdl_tpu.common.resilience import (
+        RETRY_EXHAUSTED_EXIT_CODE,
+        RetryBudgetExhausted,
+    )
+
+    # Chaos runs propagate their seeded fault schedule to subprocess
+    # workers via the environment; no-op otherwise.
+    faults.configure_from_env()
+    try:
+        return _main(argv)
+    except RetryBudgetExhausted as exc:
+        # The master stayed unreachable past the whole retry budget
+        # (at startup or mid-run).  Exit with the distinct charged code
+        # so the pod manager relaunches us instead of us spinning on a
+        # dead control plane.
+        logger.error("Worker retry budget exhausted: %s", exc)
+        sys.exit(RETRY_EXHAUSTED_EXIT_CODE)
+
+
+def _main(argv=None):
     args = args_lib.parse_worker_args(argv)
     # honor the job's persistent compile cache (--compilation_cache_dir,
     # or a parent-provided env var) even though sitecustomize imported
@@ -100,7 +131,13 @@ def main(argv=None):
         os.environ.get(WorkerEnv.WORKER_ID, args.worker_id)
     )
     master_addr = os.environ.get(WorkerEnv.MASTER_ADDR, args.master_addr)
-    client = build_master_client(master_addr)
+    from elasticdl_tpu.common.resilience import default_policy
+
+    budget = getattr(args, "rpc_retry_budget_s", 0.0)
+    rpc_policy = (
+        default_policy(max_elapsed_s=budget) if budget else default_policy()
+    )
+    client = build_master_client(master_addr, retry_policy=rpc_policy)
     spec = get_model_spec(
         args.model_zoo,
         args.model_def,
@@ -178,6 +215,7 @@ def main(argv=None):
                 if args.profile_dir
                 else ""
             ),
+            rpc_policy=rpc_policy,
         )
     else:
         worker = Worker(

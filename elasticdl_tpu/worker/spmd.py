@@ -36,6 +36,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import resilience
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_handler import ModelSpec
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -51,6 +52,7 @@ def wait_for_confirmed_epoch(
     worker_id: int,
     poll_s: float = 0.5,
     timeout_s: Optional[float] = None,
+    rpc_policy: Optional[resilience.RetryPolicy] = None,
 ):
     """Block until this worker is a member of a SETTLED and GROUP-CONFIRMED
     epoch; returns (cluster_spec, my_worker_spec), or (None, None) on
@@ -76,13 +78,21 @@ def wait_for_confirmed_epoch(
 
     from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
+    if rpc_policy is None:
+        rpc_policy = resilience.default_policy()
     deadline = None if timeout_s is None else _time.time() + timeout_s
     confirm = 0
     while True:
-        spec = client.get_cluster_spec(
-            pb.GetClusterSpecRequest(
-                worker_id=worker_id, confirm_epoch=confirm
-            )
+        # Each poll gets the full per-call retry budget; a master that
+        # stays dead past it raises RetryBudgetExhausted out of the wait
+        # (worker/main.py turns that into exit code 45).
+        spec = rpc_policy.call(
+            lambda: client.get_cluster_spec(
+                pb.GetClusterSpecRequest(
+                    worker_id=worker_id, confirm_epoch=confirm
+                )
+            ),
+            description="get_cluster_spec",
         )
         me = next(
             (w for w in spec.workers if w.worker_id == worker_id), None
@@ -127,8 +137,15 @@ class SPMDWorker:
         profile_dir: str = "",
         steps_per_execution: int = 1,
         compact_wire: bool = False,
+        rpc_policy: Optional[resilience.RetryPolicy] = None,
     ):
         self.worker_id = worker_id
+        # One policy for every control-plane RPC this rank makes; budget
+        # exhaustion propagates to worker/main.py -> exit code 45.
+        self._rpc_policy = (
+            rpc_policy if rpc_policy is not None
+            else resilience.default_policy()
+        )
         self.spec = spec
         self.minibatch_size = minibatch_size
         # --compact_wire (same contract as Worker): parse straight into
@@ -383,18 +400,20 @@ class SPMDWorker:
                     self._save(force=True)
                     self._saver.wait_until_finished()
                 return False
-            try:
-                resp = self._client.get_spmd_task(
+            # Bounded, jittered retries replace the old fixed-sleep
+            # infinite loop; exhaustion raises RetryBudgetExhausted,
+            # which worker/main.py maps to exit code 45 so the pod
+            # manager relaunches us (charged against the budget).
+            resp = self._rpc_policy.call(
+                lambda: self._client.get_spmd_task(
                     pb.GetSpmdTaskRequest(
                         worker_id=self.worker_id,
                         rendezvous_id=self._epoch,
                         seq=seq,
                     )
-                )
-            except Exception as exc:
-                logger.warning("get_spmd_task failed: %s; retrying", exc)
-                time.sleep(self._wait_sleep_s)
-                continue
+                ),
+                description="get_spmd_task",
+            )
             if resp.job_finished:
                 logger.info(
                     "Job finished; SPMD rank %d exiting", self.process_id
@@ -797,8 +816,11 @@ class SPMDWorker:
         # growing into a multi-process world must also restart — its XLA
         # backend already exists, so jax.distributed.initialize would
         # refuse to run in this process.
-        peek = self._client.get_cluster_spec(
-            pb.GetClusterSpecRequest(worker_id=self.worker_id)
+        peek = self._rpc_policy.call(
+            lambda: self._client.get_cluster_spec(
+                pb.GetClusterSpecRequest(worker_id=self.worker_id)
+            ),
+            description="get_cluster_spec.peek",
         )
         if peek.world_size > 1 or peek.expected_world_size > 1:
             self._restart_for_topology_change()
@@ -812,6 +834,7 @@ class SPMDWorker:
                 self.worker_id,
                 poll_s=self._wait_sleep_s,
                 timeout_s=settle_timeout_s,
+                rpc_policy=self._rpc_policy,
             )
         finally:
             self._in_rendezvous_wait = False

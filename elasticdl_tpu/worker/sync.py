@@ -42,11 +42,18 @@ class ModelOwner:
         checkpoint_saver=None,
         checkpoint_steps: int = 0,
     ):
+        from elasticdl_tpu.worker.trainer import run_device_serialized
+
         self.trainer = trainer
         self.lock = threading.RLock()
         self.state = None
         self.sample_features = None
-        self._rng = jax.random.PRNGKey(seed)
+        # serialized: owners are constructed on the pod-relaunch path
+        # while sibling workers are mid-step, and the key creation is a
+        # device op (see trainer._CPU_EXEC_LOCK)
+        self._rng = run_device_serialized(
+            lambda: jax.random.PRNGKey(seed)
+        )
         self.checkpoint_saver = checkpoint_saver
         self.checkpoint_steps = checkpoint_steps
 
@@ -75,8 +82,12 @@ class ModelOwner:
 
     def has_trained_state(self) -> bool:
         """True if the owner holds (or can restore) non-random params."""
+        from elasticdl_tpu.worker.trainer import run_device_serialized
+
         with self.lock:
-            if self.state is not None and int(self.state.step) > 0:
+            if self.state is not None and run_device_serialized(
+                lambda: int(self.state.step)
+            ) > 0:
                 return True
             return (
                 self.checkpoint_saver is not None
@@ -85,8 +96,14 @@ class ModelOwner:
 
     @property
     def step(self) -> int:
+        from elasticdl_tpu.worker.trainer import run_device_serialized
+
         with self.lock:
-            return 0 if self.state is None else int(self.state.step)
+            if self.state is None:
+                return 0
+            # serialized device->host fetch: a transfer racing another
+            # thread's step execution corrupts the CPU backend
+            return run_device_serialized(lambda: int(self.state.step))
 
     # ---- serialized model operations ----------------------------------
 

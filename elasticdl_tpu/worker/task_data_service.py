@@ -14,6 +14,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, Optional, Tuple
 
+from elasticdl_tpu.common import resilience
+from elasticdl_tpu.common.faults import InjectedFault
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
@@ -27,6 +29,14 @@ def _is_rpc_error(exc: Exception) -> bool:
         return isinstance(exc, grpc.RpcError)
     except ImportError:  # pragma: no cover
         return False
+
+
+def _retryable(exc: BaseException) -> bool:
+    """This service's historical contract: ANY RpcError retries (the
+    master owns task semantics; every transport failure is transient to
+    us), and injected faults behave like transport failures.  Anything
+    else — application errors — propagates immediately."""
+    return _is_rpc_error(exc) or isinstance(exc, InjectedFault)
 
 
 def prefetch_batches(iterator, depth: int = 2):
@@ -84,46 +94,66 @@ def prefetch_batches(iterator, depth: int = 2):
 
 class TaskDataService:
     def __init__(self, master_client, data_reader, worker_id: int,
-                 wait_sleep_s: float = 0.5, master_grace_s: float = 30.0):
+                 wait_sleep_s: float = 0.5, master_grace_s: float = 30.0,
+                 rpc_policy: Optional[resilience.RetryPolicy] = None):
         self._client = master_client
         self._reader = data_reader
         self._worker_id = worker_id
         self._wait_sleep_s = wait_sleep_s
         self.master_grace_s = master_grace_s
+        base = (
+            rpc_policy if rpc_policy is not None
+            else resilience.default_policy()
+        )
+        # get_task gets the master-grace budget (exhaustion == the job is
+        # over or the master is lost); reports get a short budget because
+        # the lease reaper re-queues whatever a lost report covered.
+        self._get_policy = base.with_overrides(
+            max_elapsed_s=master_grace_s,
+            initial_backoff_s=min(wait_sleep_s, 0.5),
+            retryable=_retryable,
+        )
+        self._report_policy = base.with_overrides(
+            max_elapsed_s=min(10.0, master_grace_s), retryable=_retryable
+        )
 
-    def get_task(self, task_type=None) -> Tuple[Optional[pb.Task], bool]:
+    def get_task(
+        self, task_type=None, should_stop=None
+    ) -> Tuple[Optional[pb.Task], bool]:
         """Poll the master for a task.  Returns (task|None, job_finished);
         blocks through WAIT responses with backoff.  Transient RPC failures
-        are retried; a master unreachable for `master_grace_s` means the
-        job is over (master exits after completion) or lost — either way
-        the worker must stop."""
-        deadline = None
+        retry under the shared policy (backoff + jitter); a master
+        unreachable past the `master_grace_s` budget means the job is over
+        (master exits after completion) or lost — either way the worker
+        must stop.
+
+        `should_stop`: optional callable checked between WAIT polls; when
+        it turns true, returns (None, False) so the caller regains control
+        — without it a worker parked on WAIT (e.g. the last shard of an
+        epoch leased to another worker) never notices a drain request
+        until a task happens to arrive."""
         while True:
             req = pb.GetTaskRequest(worker_id=self._worker_id)
             if task_type is not None:
                 req.task_type = task_type
                 req.filter_by_type = True
             try:
-                resp = self._client.get_task(req)
-                deadline = None
-            except Exception as exc:  # grpc.RpcError and friends
-                if not _is_rpc_error(exc):
-                    raise
-                now = time.time()
-                if deadline is None:
-                    deadline = now + self.master_grace_s
-                if now > deadline:
-                    logger.error(
-                        "Master unreachable for %.0fs; worker %d stopping",
-                        self.master_grace_s, self._worker_id,
-                    )
-                    return None, True
-                time.sleep(self._wait_sleep_s)
-                continue
+                resp = self._get_policy.call(
+                    lambda: self._client.get_task(req),
+                    description="get_task",
+                )
+            except resilience.RetryBudgetExhausted:
+                logger.error(
+                    "Master unreachable for %.0fs; worker %d stopping",
+                    self.master_grace_s, self._worker_id,
+                )
+                return None, True
             if resp.job_finished:
                 return None, True
             task = resp.task
             if task.task_id < 0 or task.type == pb.WAIT:
+                if should_stop is not None and should_stop():
+                    return None, False
                 time.sleep(self._wait_sleep_s)
                 continue
             return task, False
@@ -144,9 +174,14 @@ class TaskDataService:
             # durability — no cross-host clock comparison).
             req.exec_counters["model_version"] = model_version
         try:
-            self._client.report_task_result(req)
+            self._report_policy.call(
+                lambda: self._client.report_task_result(req),
+                description="report_task_result",
+            )
         except Exception as exc:
-            if not _is_rpc_error(exc):
+            if not (_is_rpc_error(exc)
+                    or isinstance(exc, (InjectedFault,
+                                        resilience.RetryBudgetExhausted))):
                 raise
             # Lost report: the master's lease timeout / failure detector
             # re-queues the task (at-least-once contract).

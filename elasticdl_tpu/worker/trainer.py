@@ -127,11 +127,18 @@ class Trainer:
 
     def replace_state(self, state: "TrainState") -> "TrainState":
         """Re-place existing state onto the current mesh (single-process
-        resharding; multi-host restores from checkpoint instead)."""
-        host_state = jax.tree.map(
-            lambda x: np.asarray(x) if hasattr(x, "shape") else x, state
-        )
-        return jax.device_put(host_state, self.state_sharding(state))
+        resharding; multi-host restores from checkpoint instead).  The
+        device->host copy and re-placement are one serialized device
+        operation: a remesh racing another thread's step execution
+        corrupts the CPU backend (see _CPU_EXEC_LOCK)."""
+
+        def _replace():
+            host_state = jax.tree.map(
+                lambda x: np.asarray(x) if hasattr(x, "shape") else x, state
+            )
+            return jax.device_put(host_state, self.state_sharding(state))
+
+        return run_device_serialized(_replace)
 
     # ---- state ---------------------------------------------------------
 
@@ -308,8 +315,15 @@ class Trainer:
 
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
         mesh_lib.set_current_mesh(self.mesh)  # for mesh-aware model code
-        batch = mesh_lib.shard_batch(batch, self.mesh)
-        state, loss = run_device_serialized(self.train_step, state, batch)
+
+        # The batch transfer rides inside the serialized region: a
+        # device_put racing another thread's step execution corrupts the
+        # virtual multi-device CPU backend (see _CPU_EXEC_LOCK).
+        def _step():
+            sharded = mesh_lib.shard_batch(batch, self.mesh)
+            return self.train_step(state, sharded)
+
+        state, loss = run_device_serialized(_step)
         return state, loss
 
     def train_on_batch_stack(self, state, batches):
@@ -320,12 +334,14 @@ class Trainer:
         mesh_lib.set_current_mesh(self.mesh)
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
         sharding = mesh_lib.stacked_data_sharding(self.mesh)
-        stacked = jax.tree.map(
-            lambda x: jax.device_put(x, sharding), stacked
-        )
-        return run_device_serialized(
-            self.train_step_many, state, stacked
-        )
+
+        def _step():
+            placed = jax.tree.map(
+                lambda x: jax.device_put(x, sharding), stacked
+            )
+            return self.train_step_many(state, placed)
+
+        return run_device_serialized(_step)
 
     def train_on_global_batch_stack(self, state, global_stacked):
         """K-step scan on an already-assembled global (K, B, ...) stack
@@ -350,12 +366,14 @@ class Trainer:
 
     def predict_on_batch(self, state, features):
         mesh_lib.set_current_mesh(self.mesh)
-        features = jax.tree.map(
-            lambda x: jax.device_put(x, self._data), features
-        )
-        return np.asarray(
-            run_device_serialized(self.eval_step, state, features)
-        )
+
+        def _step():
+            placed = jax.tree.map(
+                lambda x: jax.device_put(x, self._data), features
+            )
+            return np.asarray(self.eval_step(state, placed))
+
+        return run_device_serialized(_step)
 
     # ---- elastic prewarm ----------------------------------------------
 

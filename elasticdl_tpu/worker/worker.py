@@ -24,7 +24,7 @@ from elasticdl_tpu.common.model_handler import ModelSpec
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.worker.sync import ModelOwner
 from elasticdl_tpu.worker.task_data_service import TaskDataService
-from elasticdl_tpu.worker.trainer import Trainer
+from elasticdl_tpu.worker.trainer import Trainer, run_device_serialized
 
 logger = get_logger(__name__)
 
@@ -219,7 +219,9 @@ class Worker:
                 )
                 self._owner.save_and_flush()
                 return False
-            task, finished = self._data_service.get_task()
+            task, finished = self._data_service.get_task(
+                should_stop=lambda: getattr(self, "_stop_requested", False)
+            )
             if finished:
                 logger.info("Job finished; worker %d exiting", self.worker_id)
                 if self.step_timer.steps_per_sec:
@@ -227,6 +229,10 @@ class Worker:
                 self._summary.close()
                 invoke_callbacks(self.spec.callbacks, "on_job_end")
                 return True
+            if task is None:
+                # woken out of the WAIT loop by should_stop: loop back so
+                # the drain check at the top runs
+                continue
             self._maybe_remesh()
             try:
                 invoke_callbacks(self.spec.callbacks, "on_task_start", task)
@@ -351,7 +357,11 @@ class Worker:
             # host every batch would serialize the device pipeline.
             self._summary.scalars(
                 {
-                    "train/loss": float(np.asarray(loss)),
+                    # serialized: a device->host fetch racing another
+                    # thread's step execution corrupts the CPU backend
+                    "train/loss": run_device_serialized(
+                        lambda: float(np.asarray(loss))
+                    ),
                     "train/steps_per_sec": self.step_timer.steps_per_sec,
                 },
                 step=self._owner.step,
@@ -445,7 +455,15 @@ class Worker:
         and re-place (or restore) state before processing the next task."""
         if self._elastic is None:
             return
-        spec = self._elastic.fetch_spec()
+        try:
+            spec = self._elastic.fetch_spec()
+        except Exception as exc:
+            # The spec fetch sits outside the per-task error handling; a
+            # transient failure (master briefly unreachable, injected
+            # rendezvous fault) must skip this remesh round, not kill the
+            # worker — the next loop iteration fetches again.
+            logger.warning("cluster spec fetch failed: %s; will retry", exc)
+            return
         if not self._elastic.is_new_epoch(spec):
             return
         mesh = self._elastic.build_mesh(spec)

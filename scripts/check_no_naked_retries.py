@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Lint: no naked retry loops in elasticdl_tpu/.
+
+A "naked retry" is the pattern the unified policy (common/resilience.py)
+exists to replace:
+
+    while True:
+        try:
+            do_rpc()
+        except SomeError:
+            time.sleep(2)   # fixed interval, no jitter, no budget
+
+i.e. an unconditional loop whose exception handler sleeps for a CONSTANT
+interval.  Such loops retry forever with no backoff growth, no jitter (so
+every worker re-hammers the master in lockstep) and no give-up budget (so
+a dead master leaves zombie workers).  New code must route retries through
+`RetryPolicy.call` instead.
+
+Variable-interval sleeps (e.g. `time.sleep(backoff)` with a growing
+`backoff`) are NOT flagged: that is a hand-rolled but bounded backoff, and
+flagging it would force churn in loops that are structurally fine (the
+k8s watch reconnect loop).  The policy's own sleep goes through an
+injected `self._sleep`, so resilience.py passes by construction; it is
+also explicitly allowlisted to stay robust against refactors there.
+
+Exit status: 0 when clean, 1 with one `path:line: message` per finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ALLOWLIST = {os.path.join("elasticdl_tpu", "common", "resilience.py")}
+
+
+def _is_constant_sleep(node: ast.AST) -> bool:
+    """A call to `sleep`/`*.sleep` with a literal (constant) interval."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = (
+        func.attr if isinstance(func, ast.Attribute)
+        else func.id if isinstance(func, ast.Name)
+        else None
+    )
+    if name != "sleep" or not node.args:
+        return False
+    return isinstance(node.args[0], ast.Constant)
+
+
+def _is_unconditional(loop: ast.While) -> bool:
+    return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+
+
+def find_naked_retries(tree: ast.AST):
+    """Yield (lineno, description) for every while-True loop containing a
+    try whose exception handler sleeps a constant interval."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.While) and _is_unconditional(node)):
+            continue
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Try):
+                continue
+            for handler in child.handlers:
+                for stmt in handler.body:
+                    for sub in ast.walk(stmt):
+                        if _is_constant_sleep(sub):
+                            yield (
+                                sub.lineno,
+                                "fixed-interval sleep in a retry handler "
+                                "inside `while True` — use "
+                                "resilience.RetryPolicy.call instead",
+                            )
+
+
+def check_file(path: str):
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
+    return list(find_naked_retries(tree))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "elasticdl_tpu",
+    )
+    findings = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            if rel in ALLOWLIST:
+                continue
+            for lineno, message in check_file(path):
+                findings.append(f"{rel}:{lineno}: {message}")
+    for line in findings:
+        print(line)
+    if findings:
+        print(f"{len(findings)} naked retry loop(s) found", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,484 @@
+"""Chaos soak: a multi-worker job under a seeded fault schedule.
+
+The full elastic stack — a real `Master` (task manager + rendezvous + pod
+manager + recovery clock + servicer) over a fake k8s whose pods are worker
+threads — runs to completion while the installed `FaultRegistry` injects
+RPC errors/delays/drops at every control-plane injection point, the test
+kills two workers mid-job, and the newest checkpoint is corrupted (torn
+write) to force the integrity fallback.  Asserted:
+
+- the job converges with full data coverage despite all of the above;
+- `Master.snapshot()` records >= 2 recovery durations (RecoveryClock) and
+  non-zero retry/fault counters;
+- two runs with the same seed emit byte-identical fault traces.
+
+The schedule is explicit (still seed-derived) rather than
+`FaultRegistry.from_seed`: `pod.watch` must stay delay-only, because
+dropping a FAILED event would park recovery on the 900s lease reaper —
+determinism requires faults the workload is guaranteed to reach and
+survive quickly.  The workers train a pure-numpy model (see
+`NumpyTrainer`): the soak proves the robustness machinery, not XLA, and
+the virtual multi-device CPU backend corrupts its native heap when
+several threads execute programs against it — even with every device
+call serialized — a pre-existing backend hazard observable at the seed
+via tests/test_elasticity.py.  Checkpoint writes are driven by the test
+controller (main thread) from host snapshots of a worker's state inside
+the second outage window, between killing the workers and emitting
+their FAILED events, so the injected-write/corruption/fallback sequence
+hits deterministic hit indices.  Everything is in-process and seeded,
+hence `chaos` (not `slow`): this IS the tier-1 proof of the robustness
+claims.
+"""
+
+import os
+import random
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import args as args_lib
+from elasticdl_tpu.common import faults, resilience
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.k8s_client import FakeK8sClient
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.data.reader import TFRecordDataReader
+from elasticdl_tpu.master.main import Master
+from elasticdl_tpu.parallel.elastic import ElasticMeshManager
+from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.proto.service import InProcessMasterClient
+from elasticdl_tpu.worker.sync import ModelOwner
+from elasticdl_tpu.worker.trainer import TrainState
+from elasticdl_tpu.worker.worker import Worker
+
+pytestmark = pytest.mark.chaos
+
+SEED = 20240805
+PLANNED_FAULTS = 12
+NOTES = 4  # 3 worker kills + 1 checkpoint corruption
+STEP_S = 0.05  # per-step pacing so kills land while tasks remain
+
+
+class NumpyTrainer:
+    """JAX-free stand-in for `Trainer` (the surface ModelOwner uses).
+
+    One-parameter least-squares fit: loss = (w - mean(labels))^2, plain
+    gradient descent.  No XLA program ever executes in a worker thread —
+    the point, given the backend hazard described in the module
+    docstring.  Each step sleeps STEP_S so the controller's milestone
+    polling always catches the job mid-flight (pacing, not
+    correctness)."""
+
+    def __init__(self, lr: float = 0.1):
+        self.lr = lr
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> None:
+        self.mesh = mesh
+
+    def replace_state(self, state):
+        return state  # host-resident numpy: nothing to re-place
+
+    def init_state(self, rng, sample_features):
+        del rng, sample_features
+        return TrainState(
+            step=np.zeros((), np.int64),
+            params={"w": np.zeros((), np.float32)},
+            opt_state={},
+            model_state={},
+        )
+
+    def train_on_batch(self, state, batch):
+        time.sleep(STEP_S)
+        target = float(np.mean(batch["labels"]))
+        w = float(state.params["w"])
+        err = w - target
+        new_params = {"w": np.float32(w - self.lr * 2.0 * err)}
+        return (
+            state.replace(step=state.step + 1, params=new_params),
+            np.float32(err * err),
+        )
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    from model_zoo.mnist.data import write_dataset
+
+    root = tmp_path_factory.mktemp("mnist_chaos")
+    return write_dataset(str(root), n_train=256, n_val=64)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_model_spec(
+        "model_zoo", "mnist.mnist_functional_api.custom_model"
+    )
+
+
+class PreemptedError(BaseException):
+    """Simulated pod preemption (BaseException: sudden death, bypasses the
+    worker's task-level error handling AND the retry policy)."""
+
+
+def build_registry(seed: int) -> FaultRegistry:
+    """The soak's fault plan, derived from `seed` (delays come from a
+    seeded rng; the hit indices are fixed low so the workload provably
+    reaches every one).  Every injection point is covered; every fault is
+    one the surrounding resilience machinery must absorb."""
+    rng = random.Random(seed)
+
+    def delayed(point, at):
+        return FaultSpec(point, at, "delay", round(rng.uniform(0.01, 0.04), 3))
+
+    schedule = [
+        FaultSpec(faults.POINT_RPC_GET_TASK, 1, "raise"),
+        FaultSpec(faults.POINT_RPC_GET_TASK, 4, "drop"),
+        FaultSpec(faults.POINT_RPC_REPORT, 0, "raise"),
+        delayed(faults.POINT_RPC_REPORT, 3),
+        FaultSpec(faults.POINT_RENDEZVOUS_JOIN, 2, "raise"),
+        delayed(faults.POINT_RENDEZVOUS_JOIN, 5),
+        # fired by the controller's 4 save() calls: hits 0/2 succeed,
+        # hits 1/3 are injected failures
+        FaultSpec(faults.POINT_CHECKPOINT_WRITE, 1, "raise"),
+        FaultSpec(faults.POINT_CHECKPOINT_WRITE, 3, "raise"),
+        FaultSpec(faults.POINT_WORKER_HEARTBEAT, 0, "raise"),
+        FaultSpec(faults.POINT_WORKER_HEARTBEAT, 2, "drop"),
+        # delay-only: a dropped FAILED event would stall recovery until
+        # the lease reaper (900s) — not survivable inside a soak budget
+        delayed(faults.POINT_POD_WATCH, 0),
+        delayed(faults.POINT_POD_WATCH, 2),
+    ]
+    assert len(schedule) == PLANNED_FAULTS
+    return FaultRegistry(schedule, seed=seed)
+
+
+class ChaosCluster:
+    """Pods are worker threads (each with its own model state, as in
+    tests/test_elasticity.py); FakeK8sClient events drive their life.
+    `servicer` is bound after the Master is constructed and before
+    `master.start()` launches the pods."""
+
+    def __init__(self, train_dir, spec):
+        self.train_dir = train_dir
+        self.spec = spec
+        self.servicer = None
+        self.threads = {}
+        self.alive_flags = {}
+        self.workers = {}
+        self.pod_names = {}
+        # Milestone gate: while paused, every worker blocks at its next
+        # task boundary.  The controller pauses before each outage so the
+        # kill/emit/measure choreography never races job completion —
+        # fault-retry backoffs otherwise pile the task completions into
+        # the job's last few hundred ms and the milestones land after the
+        # final report (observed: a whole soak finishing before kill #1).
+        self.gate_paused = threading.Event()
+        self.k8s = FakeK8sClient()
+        orig_create = self.k8s.create_pod
+        orig_delete = self.k8s.delete_pod
+
+        def create_pod(spec_):
+            orig_create(spec_)
+            if spec_.pod_type == "worker":
+                self._start_worker_thread(spec_.worker_id, spec_.name)
+
+        def delete_pod(name):
+            wid = next(
+                (w for w, n in list(self.pod_names.items()) if n == name),
+                None,
+            )
+            if wid is not None:
+                self.kill_worker(wid)
+            orig_delete(name)
+
+        self.k8s.create_pod = create_pod
+        self.k8s.delete_pod = delete_pod
+
+    def pause(self):
+        self.gate_paused.set()
+
+    def resume(self):
+        self.gate_paused.clear()
+
+    def kill_worker(self, worker_id):
+        """Kill the pod 'process' and wait for it to die, so the FAILED
+        event always trails the death (as in real k8s)."""
+        self.alive_flags[worker_id].clear()
+        thread = self.threads.get(worker_id)
+        if thread is not None:
+            thread.join(timeout=60)
+            assert not thread.is_alive(), (
+                f"worker {worker_id} did not die within 60s"
+            )
+
+    def kill_all(self):
+        for alive in self.alive_flags.values():
+            alive.clear()
+
+    def alive_owners(self):
+        """(worker_id, ModelOwner) of every still-alive worker thread."""
+        return [
+            (wid, self.workers[wid]._owner)
+            for wid, alive in self.alive_flags.items()
+            if alive.is_set() and wid in self.workers
+        ]
+
+    def _start_worker_thread(self, worker_id, pod_name):
+        self.pod_names[worker_id] = pod_name
+        alive = threading.Event()
+        alive.set()
+        self.alive_flags[worker_id] = alive
+        client = InProcessMasterClient(self.servicer)
+        reader = TFRecordDataReader(self.train_dir)
+        # One device per worker keeps the elastic remesh cycle real
+        # (epoch bumps rebuild the mesh, rendezvous.join still fires);
+        # the training itself never executes on it (see NumpyTrainer).
+        device = jax.devices()[worker_id % len(jax.devices())]
+        elastic = ElasticMeshManager(
+            client,
+            worker_id,
+            devices_for_world=lambda n: [device],
+        )
+        worker = Worker(
+            worker_id=worker_id,
+            master_client=client,
+            data_reader=reader,
+            spec=self.spec,
+            minibatch_size=32,
+            elastic_manager=elastic,
+            model_owner=ModelOwner(NumpyTrainer(), seed=SEED),
+        )
+        self.workers[worker_id] = worker
+
+        orig_process = worker._process_task
+
+        def guarded_process(task):
+            while self.gate_paused.is_set() and alive.is_set():
+                time.sleep(0.005)  # held at the milestone gate
+            if not alive.is_set():
+                raise PreemptedError()
+            # Liveness beat at every task boundary: drives the
+            # worker.heartbeat injection point (hit indices, not timing,
+            # schedule the faults — so no daemon-timer nondeterminism).
+            try:
+                client.keep_alive(
+                    pb.KeepAliveRequest(
+                        worker_id=worker_id,
+                        timestamp_ms=0,
+                        address="in-process",
+                    )
+                )
+            except Exception:
+                pass  # liveness is best-effort by contract
+            return orig_process(task)
+
+        worker._process_task = guarded_process
+
+        # The gate must also cover the WAIT loop inside get_task: at an
+        # epoch boundary the last shard's lease can be held by a
+        # gate-blocked sibling, leaving this worker parked on WAIT where
+        # neither the pause nor the kill could reach it (observed as a
+        # 60s kill_worker timeout).
+        orig_get = worker._data_service.get_task
+
+        def guarded_get(task_type=None, should_stop=None):
+            while self.gate_paused.is_set() and alive.is_set():
+                time.sleep(0.005)
+            if not alive.is_set():
+                raise PreemptedError()
+
+            def stop():
+                if should_stop is not None and should_stop():
+                    return True
+                return self.gate_paused.is_set() or not alive.is_set()
+
+            return orig_get(task_type, should_stop=stop)
+
+        worker._data_service.get_task = guarded_get
+
+        def run():
+            try:
+                worker.run()
+            except PreemptedError:
+                pass  # pod died silently
+
+        thread = threading.Thread(target=run, daemon=True)
+        self.threads[worker_id] = thread
+        thread.start()
+
+
+def _await(cond, timeout_s, message):
+    deadline = time.time() + timeout_s
+    while not cond() and time.time() < deadline:
+        time.sleep(0.05)
+    assert cond(), message
+
+
+def _host_snapshot(cluster):
+    """Full host-side (numpy) copy of the most-trained worker's state,
+    taken under that owner's lock.  Called only while every worker thread
+    is stopped (see module docstring), so nothing concurrently donates
+    the buffers being read; copying to host detaches the snapshot from
+    the device entirely."""
+    best = None
+    for worker in cluster.workers.values():
+        owner = worker._owner
+        if owner.step >= 1 and (best is None or owner.step > best.step):
+            best = owner
+    assert best is not None, "no worker has trained state yet"
+    with best.lock:
+        return jax.tree.map(lambda x: np.asarray(x), best.state)
+
+
+def _truncate_largest_file(step_dir):
+    """A torn write: the step's biggest payload file loses its tail."""
+    paths = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            full = os.path.join(root, name)
+            paths.append((os.path.getsize(full), full))
+    assert paths, f"no files under {step_dir}"
+    size, victim = max(paths)
+    assert size > 1, f"nothing to truncate in {step_dir}"
+    with open(victim, "r+b") as f:
+        f.truncate(size // 2)
+
+
+def _run_soak(seed, base_dir, train_dir, spec):
+    os.makedirs(base_dir)
+    ckpt_dir = os.path.join(base_dir, "ckpt")
+    reg = faults.install(build_registry(seed))
+    resilience.reset_stats()
+    cluster = ChaosCluster(train_dir, spec)
+    saver = CheckpointSaver(ckpt_dir, keep_max=20, async_save=False)
+    args = args_lib.parse_master_args([
+        "--training_data", train_dir,
+        "--records_per_task", "32",
+        "--num_epochs", "2",
+        "--minibatch_size", "32",
+        "--num_workers", "2",
+        "--job_name", "chaos",
+        "--checkpoint_dir", ckpt_dir,
+        "--relaunch_on_worker_failure", "3",
+    ])
+    master = Master(args, k8s_client=cluster.k8s)
+    cluster.servicer = master.servicer
+    try:
+        # Control plane only — no gRPC server.  Workers are in-process
+        # threads on InProcessMasterClient; a live gRPC C-core server
+        # sharing the process with XLA CPU execution threads corrupts the
+        # native heap (observed as segfaults/aborts inside
+        # `block_until_ready` with the server completely idle).
+        master.task_manager.start_lease_reaper()
+        master.pod_manager.start()
+        master.task_manager.maybe_finish_if_drained()
+        tm = master.task_manager
+        clock = master.recovery_clock
+
+        # ---- kill #1: preempt worker 0 after provable progress --------
+        _await(lambda: tm.counters.finished >= 2, 120,
+               "no progress before kill #1")
+        cluster.pause()
+        cluster.kill_worker(0)
+        reg.note("worker.kill", "worker-0")
+        cluster.k8s.emit(cluster.pod_names[0], PodStatus.FAILED)
+        # the loss must be on the clock BEFORE work resumes, so the first
+        # post-outage report deterministically closes the recovery window
+        _await(lambda: clock.snapshot()["losses"] >= 1, 60,
+               "loss #1 never reached the recovery clock")
+        cluster.resume()
+
+        # ---- outage #2: kill every worker, then checkpoint chaos ------
+        _await(lambda: tm.counters.finished >= 6, 120,
+               "no progress before kill #2")
+        cluster.pause()
+        killed = sorted(
+            wid for wid, alive in cluster.alive_flags.items()
+            if alive.is_set()
+        )
+        assert killed, "no workers alive at outage #2"
+        for wid in killed:
+            cluster.kill_worker(wid)
+            reg.note("worker.kill", f"worker-{wid}")
+
+        # The process is quiesced (no device execution): safe to run
+        # Orbax I/O.  Two checkpoints at consecutive steps, with the two
+        # injected write failures in between (checkpoint.write hits 0/2
+        # succeed, 1/3 raise inside save() and are absorbed).
+        snap2 = _host_snapshot(cluster)
+        step2 = int(snap2.step)
+        assert step2 >= 1
+        step1 = step2 - 1
+        snap1 = snap2.replace(
+            step=np.asarray(step1, dtype=np.asarray(snap2.step).dtype)
+        )
+        assert saver.save(snap1, force=True) is True
+        assert saver.save(snap1, force=True) is False
+        assert saver.save(snap2, force=True) is True
+        assert saver.save(snap2, force=True) is False
+        steps = sorted(saver.all_steps())
+        assert steps == [step1, step2], f"unexpected steps {steps}"
+        _truncate_largest_file(os.path.join(ckpt_dir, str(step2)))
+        reg.note("checkpoint.corrupt", "latest")
+        # a restore now must skip the torn newest step and land on the
+        # previous intact one (manifest-gated fallback)
+        restored = saver.maybe_restore(snap2)
+        assert restored is not None
+        assert int(restored.step) == step1, (
+            f"expected fallback to {step1}, got {int(restored.step)}"
+        )
+        # back to life: FAILED events relaunch replacements for the dead
+        for wid in killed:
+            cluster.k8s.emit(cluster.pod_names[wid], PodStatus.FAILED)
+        _await(lambda: clock.snapshot()["losses"] >= 1 + len(killed), 60,
+               "outage #2 losses never reached the recovery clock")
+        cluster.resume()
+
+        # ---- convergence ----------------------------------------------
+        _await(lambda: tm.finished, 300,
+               f"job did not converge: {tm.snapshot()}")
+        assert tm.counters.records_done >= 512  # 256 records x 2 epochs
+        assert reg.all_fired(), f"unfired faults: {reg.unfired()}"
+        snapshot = master.snapshot()
+        trace = reg.trace_text()
+    finally:
+        cluster.resume()
+        cluster.kill_all()
+        master.stop()
+        try:
+            saver.close()
+        except Exception:
+            pass
+        faults.uninstall()
+    return trace, snapshot
+
+
+def test_chaos_soak_converges_with_byte_identical_traces(
+    mnist_data, spec, tmp_path
+):
+    train_dir, _ = mnist_data
+    trace1, snap1 = _run_soak(SEED, str(tmp_path / "run1"), train_dir, spec)
+    trace2, snap2 = _run_soak(SEED, str(tmp_path / "run2"), train_dir, spec)
+
+    # determinism: same seed, same workload -> byte-identical fault trace
+    assert trace1 == trace2
+
+    for snap in (snap1, snap2):
+        # the recovery clock measured both outages end to end
+        assert snap["recovery"]["losses"] >= 2
+        assert snap["recovery"]["recoveries"] >= 2
+        assert len(snap["recovery"]["recovery_durations_s"]) >= 2
+        assert all(d >= 0.0 for d in snap["recovery"]["recovery_durations_s"])
+        assert snap["recovery"]["pending"] is False
+        # both kills were charged and relaunched
+        assert snap["pods"]["losses_seen"] >= 2
+        assert snap["pods"]["relaunches"] >= 2
+        # injected faults were absorbed by real retries
+        assert snap["resilience"]["retries"] > 0
+        assert snap["faults"]["planned"] == PLANNED_FAULTS
+        assert snap["faults"]["injected"] == PLANNED_FAULTS
+        assert snap["faults"]["notes"] == NOTES

@@ -1,0 +1,81 @@
+"""The naked-retry lint (scripts/check_no_naked_retries.py): the tree must
+be clean, and the detector itself must catch the pattern it documents."""
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_no_naked_retries.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("naked_retries", SCRIPT)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _findings(source):
+    return list(_load().find_naked_retries(ast.parse(source)))
+
+
+def test_detects_fixed_sleep_retry_loop():
+    src = (
+        "import time\n"
+        "while True:\n"
+        "    try:\n"
+        "        do_rpc()\n"
+        "    except Exception:\n"
+        "        time.sleep(2)\n"
+    )
+    assert _findings(src), "classic naked retry not detected"
+
+
+def test_ignores_variable_backoff_and_non_handler_sleeps():
+    # growing backoff (the k8s watch reconnect shape): allowed
+    src = (
+        "import time\n"
+        "backoff = 1.0\n"
+        "while True:\n"
+        "    try:\n"
+        "        watch()\n"
+        "    except Exception:\n"
+        "        time.sleep(backoff)\n"
+        "        backoff = min(backoff * 2, 60.0)\n"
+    )
+    assert not _findings(src)
+    # sleep in the loop body, not in an exception handler: allowed
+    src = (
+        "import time\n"
+        "while True:\n"
+        "    time.sleep(0.5)\n"
+        "    poll()\n"
+    )
+    assert not _findings(src)
+    # bounded loop: allowed
+    src = (
+        "import time\n"
+        "for _ in range(3):\n"
+        "    try:\n"
+        "        do_rpc()\n"
+        "    except Exception:\n"
+        "        time.sleep(2)\n"
+    )
+    assert not _findings(src)
+
+
+def test_repo_tree_is_clean():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"naked retry loops found:\n{proc.stdout}{proc.stderr}"
+    )

@@ -1,0 +1,113 @@
+"""RecoveryClock semantics under overlapping losses, and the maintenance
+notice watcher surviving an intermittently-failing notice source (driven
+through the fault registry, so the failure pattern is deterministic)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.preemption import MaintenanceNoticeWatcher
+from elasticdl_tpu.master.recovery import RecoveryClock
+
+
+def test_single_loss_closed_by_progress():
+    clock = RecoveryClock()
+    assert clock.mark_progress() is None  # nothing pending
+    clock.mark_loss()
+    elapsed = clock.mark_progress()
+    assert elapsed is not None and elapsed >= 0.0
+    snap = clock.snapshot()
+    assert snap["losses"] == 1
+    assert snap["recoveries"] == 1
+    assert snap["recovery_durations_s"] == clock.history
+    assert snap["pending"] is False
+
+
+def test_overlapping_losses_measure_one_outage_end_to_end():
+    """A multi-loss outage (two workers die before any progress) is ONE
+    outage: the earliest pending loss wins, and the single recovery spans
+    it entirely."""
+    clock = RecoveryClock()
+    clock.mark_loss()
+    time.sleep(0.05)
+    clock.mark_loss()  # overlapping: must NOT reset the pending stamp
+    elapsed = clock.mark_progress()
+    assert elapsed is not None and elapsed >= 0.05
+    snap = clock.snapshot()
+    assert snap["losses"] == 2
+    assert snap["recoveries"] == 1
+    assert snap["pending"] is False
+    # a second progress report with nothing pending records nothing
+    assert clock.mark_progress() is None
+    assert clock.snapshot()["recoveries"] == 1
+
+
+def test_sequential_outages_each_get_a_duration():
+    clock = RecoveryClock()
+    for _ in range(2):
+        clock.mark_loss()
+        assert clock.snapshot()["pending"] is True
+        clock.mark_progress()
+    snap = clock.snapshot()
+    assert snap["losses"] == 2
+    assert snap["recoveries"] == 2
+    assert len(snap["recovery_durations_s"]) == 2
+
+
+def test_loss_while_pending_extends_not_splits():
+    """loss, progress, loss, loss, progress -> exactly two recoveries."""
+    clock = RecoveryClock()
+    clock.mark_loss()
+    clock.mark_progress()
+    clock.mark_loss()
+    clock.mark_loss()
+    clock.mark_progress()
+    snap = clock.snapshot()
+    assert snap["losses"] == 3
+    assert snap["recoveries"] == 2
+
+
+def test_notice_watcher_survives_raising_checker():
+    """The notice checker raising (flaky metadata server / unreadable
+    file) must read as no-notice and keep polling — the watcher fires on
+    the first clean positive check.  The failure pattern comes from a
+    fault registry schedule, so it is deterministic."""
+    reg = FaultRegistry(
+        [
+            FaultSpec("notice.check", 0, "raise"),
+            FaultSpec("notice.check", 1, "raise"),
+        ]
+    )
+    drained = threading.Event()
+
+    def checker():
+        reg.fire("notice.check")  # raises on the first two polls
+        return reg.hits("notice.check") >= 3
+
+    watcher = MaintenanceNoticeWatcher(checker, drained.set, poll_s=0.01)
+    watcher.start()
+    try:
+        assert drained.wait(timeout=10.0), "watcher never fired"
+        assert watcher.fired
+        assert reg.all_fired(), reg.unfired()
+        assert reg.hits("notice.check") >= 3
+    finally:
+        watcher.stop()
+
+
+def test_notice_watcher_fires_once_and_contains_hook_errors():
+    fired = []
+
+    def on_notice():
+        fired.append(1)
+        raise RuntimeError("drain hook bug")  # must be contained
+
+    watcher = MaintenanceNoticeWatcher(lambda: True, on_notice, poll_s=0.01)
+    watcher.start()
+    deadline = time.time() + 10.0
+    while not watcher.fired and time.time() < deadline:
+        time.sleep(0.01)
+    assert watcher.fired
+    assert fired == [1]  # the watcher thread exits after firing once

@@ -1,0 +1,346 @@
+"""Unit tests for the unified retry policy (common/resilience.py) and the
+seeded fault-injection registry (common/faults.py).
+
+Everything here runs on fake clocks/sleeps — no real waiting — so the
+policy's backoff math, budget accounting and giving-up behavior are
+asserted exactly, and the registry's determinism is asserted byte-for-byte.
+"""
+
+import random
+
+import pytest
+
+from elasticdl_tpu.common import faults, resilience
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.resilience import (
+    RetryBudgetExhausted,
+    RetryPolicy,
+    default_policy,
+    is_retryable_error,
+)
+
+
+class FakeTime:
+    """Deterministic clock: sleep() advances the clock, nothing blocks."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+def make_policy(**kw):
+    ft = FakeTime()
+    defaults = dict(
+        initial_backoff_s=0.1,
+        max_backoff_s=5.0,
+        max_elapsed_s=60.0,
+        rng=random.Random(kw.pop("seed", 0)),
+        sleep=ft.sleep,
+        clock=ft.clock,
+    )
+    defaults.update(kw)
+    return RetryPolicy(**defaults), ft
+
+
+class Flaky:
+    """Fails `failures` times with `exc_type`, then returns `value`."""
+
+    def __init__(self, failures, exc_type=ConnectionError, value="ok"):
+        self.failures = failures
+        self.exc_type = exc_type
+        self.value = value
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc_type(f"boom #{self.calls}")
+        return self.value
+
+
+# ---- backoff math ---------------------------------------------------------
+
+
+def test_backoff_is_full_jitter_within_exponential_ceiling():
+    policy, _ = make_policy(seed=1234)
+    for attempt in range(10):
+        ceiling = min(5.0, 0.1 * (2.0 ** attempt))
+        for _ in range(20):
+            delay = policy.backoff_s(attempt)
+            assert 0.0 <= delay <= ceiling
+
+
+def test_backoff_deterministic_under_seeded_rng():
+    a, _ = make_policy(seed=7)
+    b, _ = make_policy(seed=7)
+    assert [a.backoff_s(i) for i in range(8)] == [
+        b.backoff_s(i) for i in range(8)
+    ]
+
+
+# ---- call() semantics -----------------------------------------------------
+
+
+def test_call_retries_transient_then_succeeds():
+    policy, ft = make_policy()
+    fn = Flaky(failures=3)
+    assert policy.call(fn, description="unit") == "ok"
+    assert fn.calls == 4
+    assert len(ft.sleeps) == 3  # one backoff per failed attempt
+
+
+def test_non_retryable_error_raises_immediately():
+    policy, ft = make_policy()
+    fn = Flaky(failures=1, exc_type=ValueError)
+    with pytest.raises(ValueError):
+        policy.call(fn)
+    assert fn.calls == 1
+    assert ft.sleeps == []
+
+
+def test_base_exception_always_propagates():
+    """PreemptedError-style control flow (BaseException) must never be
+    swallowed or retried by the policy."""
+
+    class SuddenDeath(BaseException):
+        pass
+
+    policy, ft = make_policy()
+
+    def die():
+        raise SuddenDeath()
+
+    with pytest.raises(SuddenDeath):
+        policy.call(die)
+    assert ft.sleeps == []
+
+
+def test_elapsed_budget_exhaustion_raises_with_cause():
+    policy, ft = make_policy(max_elapsed_s=1.0)
+    fn = Flaky(failures=10 ** 6)
+    with pytest.raises(RetryBudgetExhausted) as info:
+        policy.call(fn, description="doomed")
+    exc = info.value
+    assert exc.description == "doomed"
+    assert exc.attempts >= 1
+    assert isinstance(exc.last_error, ConnectionError)
+    assert isinstance(exc.__cause__, ConnectionError)
+    # the budget bounds total time: elapsed + the next delay never
+    # overshoots max_elapsed_s
+    assert ft.now < 1.0
+
+
+def test_max_attempts_bounds_retry_count():
+    policy, _ = make_policy(max_attempts=3, max_elapsed_s=None)
+    fn = Flaky(failures=10 ** 6)
+    with pytest.raises(RetryBudgetExhausted) as info:
+        policy.call(fn)
+    assert fn.calls == 3
+    assert info.value.attempts == 3
+
+
+def test_give_up_hook_fires_once_and_cannot_mask_the_error():
+    seen = []
+
+    def hook(description, attempts, elapsed, exc):
+        seen.append((description, attempts))
+        raise RuntimeError("hook bug")  # must be contained
+
+    policy, _ = make_policy(max_attempts=2, max_elapsed_s=None,
+                            on_give_up=hook)
+    with pytest.raises(RetryBudgetExhausted):
+        policy.call(Flaky(failures=99), description="hooked")
+    assert seen == [("hooked", 2)]
+
+
+def test_budget_exhausted_is_itself_non_retryable():
+    inner, _ = make_policy(max_attempts=1, max_elapsed_s=None)
+    outer, ft = make_policy()
+
+    def nested():
+        return inner.call(Flaky(failures=99), description="inner")
+
+    # the outer default classification must NOT retry an exhausted budget
+    with pytest.raises(RetryBudgetExhausted):
+        outer.call(nested, description="outer")
+    assert ft.sleeps == []
+
+
+def test_with_overrides_preserves_fakes_and_changes_fields():
+    policy, ft = make_policy(max_elapsed_s=60.0)
+    derived = policy.with_overrides(max_elapsed_s=1.0, max_attempts=2)
+    assert derived.max_elapsed_s == 1.0
+    assert derived.max_attempts == 2
+    assert derived.initial_backoff_s == policy.initial_backoff_s
+    # fake sleep/clock carried over: exhausting the derived policy must
+    # not actually block
+    with pytest.raises(RetryBudgetExhausted):
+        derived.call(Flaky(failures=99))
+    assert ft.sleeps  # the derived policy slept through the fake
+
+
+def test_retry_and_giveup_counters():
+    resilience.reset_stats()
+    policy, _ = make_policy()
+    policy.call(Flaky(failures=2), description="counted")
+    with pytest.raises(RetryBudgetExhausted):
+        policy.with_overrides(max_attempts=2, max_elapsed_s=None).call(
+            Flaky(failures=99), description="counted"
+        )
+    stats = resilience.stats()
+    assert stats["retries"] >= 3
+    assert stats["giveups"] == 1
+    assert stats["retries_by_call"]["counted"] >= 3
+    resilience.reset_stats()
+    assert resilience.stats()["retries"] == 0
+
+
+# ---- classification -------------------------------------------------------
+
+
+def test_is_retryable_error_classification():
+    import grpc
+
+    assert is_retryable_error(ConnectionError("net"))
+    assert is_retryable_error(faults.InjectedFault("injected"))
+    assert is_retryable_error(faults.DroppedRequest("dropped"))
+    assert is_retryable_error(grpc.FutureTimeoutError())
+    assert not is_retryable_error(ValueError("app bug"))
+    assert not is_retryable_error(
+        RetryBudgetExhausted("d", 1, 1.0, ConnectionError())
+    )
+
+    class FakeRpcError(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    assert is_retryable_error(FakeRpcError(grpc.StatusCode.UNAVAILABLE))
+    assert is_retryable_error(
+        FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)
+    )
+    assert not is_retryable_error(
+        FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+    )
+
+
+def test_default_policy_reads_env_knobs(monkeypatch):
+    monkeypatch.setenv(resilience.ENV_MAX_ELAPSED_S, "7.5")
+    monkeypatch.setenv(resilience.ENV_INITIAL_BACKOFF_S, "0.25")
+    monkeypatch.setenv(resilience.ENV_MAX_BACKOFF_S, "2.0")
+    monkeypatch.setenv(resilience.ENV_ATTEMPT_TIMEOUT_S, "3.0")
+    policy = default_policy()
+    assert policy.max_elapsed_s == 7.5
+    assert policy.initial_backoff_s == 0.25
+    assert policy.max_backoff_s == 2.0
+    assert policy.attempt_timeout_s == 3.0
+    # explicit overrides beat the env
+    assert default_policy(max_elapsed_s=99.0).max_elapsed_s == 99.0
+    # garbage env falls back to defaults rather than crashing
+    monkeypatch.setenv(resilience.ENV_MAX_ELAPSED_S, "not-a-float")
+    assert default_policy().max_elapsed_s == 120.0
+
+
+# ---- fault registry -------------------------------------------------------
+
+
+def test_from_seed_is_deterministic():
+    a = FaultRegistry.from_seed(42)
+    b = FaultRegistry.from_seed(42)
+    assert a.trace_text() == b.trace_text()
+    assert a.schedule_json() == b.schedule_json()
+    assert FaultRegistry.from_seed(43).schedule_json() != a.schedule_json()
+    # every point got its quota of scheduled faults
+    plan_lines = [
+        line for line in a.trace_text().splitlines()
+        if line.startswith("plan ")
+    ]
+    assert len(plan_lines) == 2 * len(faults.POINTS)
+
+
+def test_fire_executes_scheduled_actions_in_hit_order():
+    reg = FaultRegistry(
+        [
+            FaultSpec("p", 1, "raise"),
+            FaultSpec("p", 2, "drop"),
+            FaultSpec("p", 3, "delay", delay_s=0.0),
+        ]
+    )
+    reg.fire("p")  # hit 0: clean
+    with pytest.raises(faults.InjectedFault):
+        reg.fire("p")  # hit 1
+    with pytest.raises(faults.DroppedRequest):
+        reg.fire("p")  # hit 2
+    reg.fire("p")  # hit 3: zero-length delay
+    assert reg.hits("p") == 4
+    assert reg.all_fired()
+    assert reg.unfired() == []
+    stats = reg.stats()
+    assert stats["planned"] == stats["injected"] == 3
+    assert stats["by_action"] == {"raise": 1, "drop": 1, "delay": 1}
+
+
+def test_unfired_lists_pending_faults():
+    reg = FaultRegistry([FaultSpec("p", 0, "raise"),
+                         FaultSpec("q", 5, "raise")])
+    with pytest.raises(faults.InjectedFault):
+        reg.fire("p")
+    assert not reg.all_fired()
+    assert reg.unfired() == ["q#5 raise"]
+
+
+def test_trace_includes_notes_in_canonical_order():
+    reg = FaultRegistry([], seed=9)
+    reg.note("worker.kill", "worker-1")
+    reg.note("worker.kill", "worker-0")
+    reg.note("checkpoint.corrupt", "latest")
+    text = reg.trace_text()
+    assert text.startswith("fault-trace v1 seed=9\n")
+    assert "note checkpoint.corrupt#0 latest" in text
+    # notes keep per-key insertion order under a stable key sort
+    assert text.index("worker.kill#0 worker-1") < text.index(
+        "worker.kill#1 worker-0"
+    )
+
+
+def test_schedule_json_roundtrip_and_env_wire():
+    reg = FaultRegistry.from_seed(11)
+    clone = FaultRegistry.from_schedule_json(reg.schedule_json(), seed=11)
+    assert clone.trace_text() == reg.trace_text()
+    env = reg.env()
+    assert env[faults.ENV_SEED] == "11"
+    rebuilt = faults.configure_from_env(environ=env)
+    try:
+        assert rebuilt is not None
+        assert rebuilt.trace_text() == reg.trace_text()
+    finally:
+        faults.uninstall()
+
+
+def test_module_fire_is_noop_without_registry():
+    faults.uninstall()
+    faults.fire(faults.POINT_RPC_GET_TASK)  # must not raise
+    faults.note("ignored")
+    assert faults.stats() == {}
+
+
+def test_installed_registry_drives_module_fire():
+    reg = faults.install(
+        FaultRegistry([FaultSpec(faults.POINT_RPC_REPORT, 0, "raise")])
+    )
+    try:
+        with pytest.raises(faults.InjectedFault):
+            faults.fire(faults.POINT_RPC_REPORT)
+        assert faults.stats()["injected"] == 1
+        assert reg.all_fired()
+    finally:
+        faults.uninstall()
