@@ -664,14 +664,102 @@ def bench_full():
     return result
 
 
+def bench_serving(
+    requests_per_client: int = 30,
+    loads=(2, 8, 32),
+    model_def: str = "mnist.mnist_functional_api.custom_model",
+):
+    """Online-serving bench: closed-loop clients against the in-process
+    engine+batcher stack (no sockets — this measures batching/execution,
+    not the NIC).  Three offered loads (concurrent clients); per load:
+    p50/p99 client-observed latency, row throughput, batch-fill ratio."""
+    import threading
+    import time
+
+    import jax
+
+    from elasticdl_tpu.common.export import feature_meta
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.serving.batcher import OK, DynamicBatcher
+    from elasticdl_tpu.serving.engine import ServingEngine
+
+    spec = get_model_spec(_ZOO, model_def)
+    sample = np.random.RandomState(0).rand(1, 784).astype(np.float32)
+    variables = dict(spec.model.init(jax.random.PRNGKey(0), sample))
+    engine = ServingEngine(
+        spec.model, variables, step=0,
+        feature_spec=feature_meta(sample), buckets=(1, 8, 32),
+    )
+    sizes = (1, 2, 5, 8)  # mixed request sizes, exercising padding
+    per_load = []
+    for clients in loads:
+        batcher = DynamicBatcher(engine, max_latency_s=0.002)
+        latencies, errors = [], []
+        lock = threading.Lock()
+
+        def run_client(seed):
+            rng = np.random.RandomState(seed)
+            mine = []
+            for _ in range(requests_per_client):
+                n = sizes[rng.randint(len(sizes))]
+                x = rng.rand(n, 784).astype(np.float32)
+                t0 = time.perf_counter()
+                result = batcher.submit({"features": x}).result(timeout=60)
+                dt = time.perf_counter() - t0
+                if result.code == OK:
+                    mine.append((dt, n))
+                else:
+                    with lock:
+                        errors.append(result.code)
+            with lock:
+                latencies.extend(mine)
+
+        threads = [
+            threading.Thread(target=run_client, args=(i,))
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        rows = sum(n for _, n in latencies)
+        lat_s = np.array([dt for dt, _ in latencies]) if latencies \
+            else np.array([0.0])
+        snapshot = batcher.metrics.snapshot()
+        batcher.shutdown()
+        per_load.append({
+            "clients": clients,
+            "rows_per_sec": round(rows / elapsed, 1),
+            "p50_ms": round(float(np.percentile(lat_s, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat_s, 99)) * 1e3, 3),
+            "batch_fill_ratio": round(snapshot["batch_fill_ratio"], 3),
+            "errors": len(errors),
+        })
+    return {
+        "bench": "serving",
+        "value": max(load["rows_per_sec"] for load in per_load),
+        "unit": "rows_per_sec",
+        "detail": {
+            "model": model_def,
+            "buckets": list(engine.buckets),
+            "compile_count": engine.compile_count,
+            "loads": per_load,
+        },
+    }
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "full"
+    which = which.lstrip("-")  # `--serving` and `serving` both work
     if which == "all":
         for fn in (bench_deepfm, bench_mnist, bench_bert):
             print(json.dumps(fn()))
     else:
         fn = {"full": bench_full, "deepfm": bench_deepfm,
               "mnist": bench_mnist, "bert": bench_bert,
+              "serving": bench_serving,
               "e2e": lambda: bench_deepfm_e2e()}[which]
         print(json.dumps(fn()))
 

@@ -211,6 +211,94 @@ def _train_local(args, job_type: str = "train") -> int:
     return 0 if ok else 1
 
 
+def serve(args) -> int:
+    """`elasticdl serve`: gRPC online inference for a zoo model, from a
+    params.msgpack export (--export_dir) or a live checkpoint directory
+    (--checkpoint_dir, with hot reload).  docs/SERVING.md."""
+    server = build_serving_server(args)
+    port = server.start(args.port)
+    logger.info(
+        "serving %s on port %d (ctrl-c to stop)", args.model_def, port
+    )
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        server.stop()
+    return 0
+
+
+def build_serving_server(args):
+    """Assemble (but do not start) the engine/batcher/reloader/server
+    stack from parsed `elasticdl serve` args — split from serve() so
+    tests and embedders drive the lifecycle themselves."""
+    import json
+    import os
+
+    import numpy as np
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.serving.batcher import DynamicBatcher
+    from elasticdl_tpu.serving.engine import ServingEngine
+    from elasticdl_tpu.serving.reloader import CheckpointReloader
+    from elasticdl_tpu.serving.server import ServingServer
+
+    if bool(args.export_dir) == bool(args.checkpoint_dir):
+        raise ValueError(
+            "elasticdl serve needs exactly one of --export_dir or "
+            "--checkpoint_dir"
+        )
+    spec = get_model_spec(
+        args.model_zoo, args.model_def, model_params=args.model_params
+    )
+    buckets = tuple(
+        int(b) for b in str(args.batch_buckets).split(",") if b.strip()
+    )
+    reloader = None
+    if args.export_dir:
+        engine = ServingEngine.from_export(
+            args.export_dir, spec, buckets=buckets
+        )
+    else:
+        feature_spec = args.feature_spec
+        if not feature_spec:
+            raise ValueError(
+                "--checkpoint_dir serving needs --feature_spec (inline "
+                "JSON or a path to an export_meta.json)"
+            )
+        if os.path.exists(feature_spec):
+            with open(feature_spec) as f:
+                meta = json.load(f)
+            feature_spec = meta.get("features", meta)
+        else:
+            feature_spec = json.loads(feature_spec)
+        sample = {
+            name: np.zeros(
+                (1, *leaf["shape"]), np.dtype(leaf["dtype"])
+            )
+            for name, leaf in feature_spec.items()
+        }
+        from elasticdl_tpu.common.export import SINGLE_FEATURE_KEY
+
+        if set(sample) == {SINGLE_FEATURE_KEY}:
+            sample = sample[SINGLE_FEATURE_KEY]
+        engine = ServingEngine.from_checkpoint(
+            args.checkpoint_dir, spec, sample, buckets=buckets
+        )
+        reloader = CheckpointReloader(
+            engine, args.checkpoint_dir,
+            poll_interval_s=args.reload_poll_seconds,
+        )
+    batcher = DynamicBatcher(
+        engine,
+        max_latency_s=args.max_batch_latency_ms / 1000.0,
+        max_queue_rows=args.max_queue_rows or None,
+        reject_oversized=args.reject_oversized,
+    )
+    return ServingServer(engine, batcher, reloader)
+
+
 def _submit_master_pod(args, job_type: str) -> int:
     """Cluster mode: create the master pod through the Kubernetes API."""
     from elasticdl_tpu.common.k8s_client import (

@@ -44,6 +44,13 @@ def _build_parser() -> argparse.ArgumentParser:
     args_lib.add_train_params(predict_parser)
     predict_parser.set_defaults(func="predict")
 
+    serve_parser = subparsers.add_parser(
+        "serve", help="serve an exported model or live checkpoint dir"
+    )
+    args_lib.add_model_params(serve_parser)
+    args_lib.add_serve_params(serve_parser)
+    serve_parser.set_defaults(func="serve")
+
     zoo_parser = subparsers.add_parser("zoo", help="model zoo image tools")
     zoo_sub = zoo_parser.add_subparsers(dest="zoo_command")
     zoo_init = zoo_sub.add_parser("init", help="scaffold a model zoo dir")
@@ -72,7 +79,7 @@ def main(argv=None) -> int:
 
     from elasticdl_tpu.client import api, image_builder
 
-    if args.func in ("train", "evaluate", "predict"):
+    if args.func in ("train", "evaluate", "predict", "serve"):
         try:
             return getattr(api, args.func)(args)
         except (ImportError, ModuleNotFoundError) as exc:

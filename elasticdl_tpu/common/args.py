@@ -244,6 +244,52 @@ def add_predict_params(parser):
     parser.add_argument("--data_reader_params", default="")
 
 
+def add_serve_params(parser):
+    """`elasticdl serve`: online inference from an export or a live
+    checkpoint directory (docs/SERVING.md)."""
+    parser.add_argument(
+        "--export_dir", default="",
+        help="directory with params.msgpack + export_meta.json "
+        "(from --output of a training job)",
+    )
+    parser.add_argument(
+        "--checkpoint_dir", default="",
+        help="serve the newest verified checkpoint and hot-reload as "
+        "the trainer writes new steps (alternative to --export_dir)",
+    )
+    parser.add_argument("--port", type=non_neg_int, default=50061)
+    parser.add_argument(
+        "--batch_buckets", default="1,4,16,64",
+        help="comma-separated batch sizes to precompile; requests are "
+        "padded to the nearest bucket",
+    )
+    parser.add_argument(
+        "--max_batch_latency_ms", type=float, default=10.0,
+        help="max time a queued request waits for batch-mates",
+    )
+    parser.add_argument(
+        "--max_queue_rows", type=non_neg_int, default=0,
+        help="admission-control bound on queued rows "
+        "(0 = 4x the largest bucket)",
+    )
+    parser.add_argument(
+        "--reject_oversized", type=str2bool, default=False,
+        help="reject requests larger than the largest bucket instead "
+        "of splitting them",
+    )
+    parser.add_argument(
+        "--reload_poll_seconds", type=float, default=10.0,
+        help="checkpoint-directory poll interval for hot reload",
+    )
+    parser.add_argument(
+        "--feature_spec", default="",
+        help="serving signature for --checkpoint_dir mode when no "
+        "export_meta.json is available: inline JSON "
+        '{"name": {"shape": [..], "dtype": ".."}} or a path to an '
+        "export_meta.json",
+    )
+
+
 def parse_master_args(argv=None):
     parser = argparse.ArgumentParser(description="elasticdl-tpu master")
     add_common_params(parser)

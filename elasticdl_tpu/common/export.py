@@ -32,6 +32,32 @@ from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
 
+# Feature-dict key used when a model's feed yields a single array instead
+# of a dict (MNIST); the serving protocol and export meta both use it so
+# single-input and dict-input models share one wire shape.
+SINGLE_FEATURE_KEY = "features"
+
+
+def feature_meta(sample_features: Any) -> dict:
+    """Per-feature serving signature: {name: {shape: per-row dims, dtype}}.
+    The batch dimension is dropped — it is the serving system's to choose."""
+
+    def leaf(v):
+        v = np.asarray(v)
+        return {
+            "shape": [int(d) for d in v.shape[1:]],
+            "dtype": str(v.dtype),
+        }
+
+    if isinstance(sample_features, dict):
+        return {str(k): leaf(v) for k, v in sample_features.items()}
+    return {SINGLE_FEATURE_KEY: leaf(sample_features)}
+
+
+def read_export_meta(output_dir: str) -> dict:
+    with open(os.path.join(output_dir, "export_meta.json")) as f:
+        return json.load(f)
+
 
 def export_model(
     state,
@@ -54,6 +80,13 @@ def export_model(
         "model_class": type(spec.model).__name__,
         "framework": "elasticdl-tpu",
     }
+    if sample_features is not None:
+        # the export's serving signature: feature keys + per-row
+        # shape/dtype.  Serving (serving/engine.py) loads against these
+        # and load_exported cross-checks them against the consumer's
+        # model, so a zoo-definition drift fails loudly at load, not as
+        # a shape error deep inside jit.
+        meta["features"] = feature_meta(sample_features)
 
     def write_meta():
         with open(os.path.join(output_dir, "export_meta.json"), "w") as f:
@@ -179,8 +212,49 @@ def export_saved_model(
     return output_dir
 
 
-def load_exported(output_dir: str, template: Any):
+def load_exported(
+    output_dir: str,
+    template: Any,
+    expected_features: Any = None,
+    check_only: bool = False,
+):
     """Restore exported variables into `template` (a {params, model_state}
-    dict with matching structure, e.g. from model.init)."""
+    dict with matching structure, e.g. from model.init).
+
+    `expected_features`: the consumer model's input signature — a sample
+    feature batch/dict, or an iterable of feature-key names.  When given
+    AND the export recorded its own signature, the key sets are
+    cross-checked and a mismatch raises ValueError naming both sides —
+    catching a zoo model whose feed was edited since the export, which
+    otherwise surfaces as an inscrutable shape error inside jit (or,
+    worse, silently mis-keyed features).  Exports from before signatures
+    were recorded skip the check.
+    """
+    if expected_features is not None:
+        meta = {}
+        try:
+            meta = read_export_meta(output_dir)
+        except (OSError, json.JSONDecodeError):
+            pass  # meta missing/corrupt: msgpack load below still governs
+        exported = meta.get("features")
+        if exported is not None:
+            if isinstance(expected_features, dict):
+                expected_keys = {str(k) for k in expected_features}
+            elif isinstance(
+                expected_features, (list, tuple, set, frozenset)
+            ):
+                expected_keys = {str(k) for k in expected_features}
+            else:  # a single sample array (MNIST-style feed)
+                expected_keys = {SINGLE_FEATURE_KEY}
+            if set(exported) != expected_keys:
+                raise ValueError(
+                    f"export at {output_dir} was written for feature keys "
+                    f"{sorted(exported)} but the model expects "
+                    f"{sorted(expected_keys)}; the model definition has "
+                    "drifted since export — re-export the model or load "
+                    "it with the matching zoo definition"
+                )
+    if check_only:
+        return None
     with open(os.path.join(output_dir, "params.msgpack"), "rb") as f:
         return serialization.from_bytes(template, f.read())

@@ -39,6 +39,8 @@ POINT_RENDEZVOUS_JOIN = "rendezvous.join"
 POINT_CHECKPOINT_WRITE = "checkpoint.write"
 POINT_WORKER_HEARTBEAT = "worker.heartbeat"
 POINT_POD_WATCH = "pod.watch"
+POINT_RPC_PREDICT = "rpc.predict"
+POINT_SERVING_RELOAD = "serving.reload"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -47,6 +49,8 @@ POINTS = (
     POINT_CHECKPOINT_WRITE,
     POINT_WORKER_HEARTBEAT,
     POINT_POD_WATCH,
+    POINT_RPC_PREDICT,
+    POINT_SERVING_RELOAD,
 )
 
 ACTIONS = ("raise", "delay", "drop")

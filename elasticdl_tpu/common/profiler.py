@@ -46,6 +46,82 @@ class StepTimer:
         logger.info("%ssteps/sec=%.2f", prefix, self.steps_per_sec)
 
 
+class LatencyHistogram:
+    """Thread-safe log-bucketed latency histogram with quantile reads.
+
+    Serving needs p50/p99 over an unbounded stream without keeping every
+    sample; log-spaced buckets give a bounded-error quantile (each bucket
+    spans `growth`x, so a reported quantile is within one growth factor of
+    truth) at O(1) record cost under a lock — the batcher records from its
+    dispatch threads while Health RPCs read concurrently.
+    """
+
+    def __init__(self, min_s: float = 1e-4, max_s: float = 60.0,
+                 growth: float = 1.25):
+        import math
+        import threading
+
+        self._min_s = min_s
+        self._log_min = math.log(min_s)
+        self._log_growth = math.log(growth)
+        nbuckets = int(math.ceil(
+            (math.log(max_s) - self._log_min) / self._log_growth
+        )) + 1
+        # bucket i covers [min_s * growth**i, min_s * growth**(i+1));
+        # underflow clamps to 0, overflow to the last bucket
+        self._uppers = [
+            min_s * growth ** (i + 1) for i in range(nbuckets)
+        ]
+        self._counts = [0] * nbuckets
+        self._total = 0
+        self._sum_s = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        import math
+
+        if seconds < self._min_s:
+            idx = 0
+        else:
+            idx = int((math.log(seconds) - self._log_min)
+                      / self._log_growth)
+            idx = min(idx, len(self._counts) - 1)
+        with self._lock:
+            self._counts[idx] += 1
+            self._total += 1
+            self._sum_s += seconds
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    def quantile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile, in seconds.
+        Returns 0.0 before any sample."""
+        with self._lock:
+            if not self._total:
+                return 0.0
+            rank = q * (self._total - 1)
+            seen = 0
+            for idx, c in enumerate(self._counts):
+                seen += c
+                if seen > rank:
+                    return self._uppers[idx]
+            return self._uppers[-1]
+
+    def snapshot(self) -> dict:
+        """{count, mean_s, p50_s, p99_s} — one consistent read."""
+        with self._lock:
+            total, sum_s = self._total, self._sum_s
+        return {
+            "count": total,
+            "mean_s": (sum_s / total) if total else 0.0,
+            "p50_s": self.quantile(0.5),
+            "p99_s": self.quantile(0.99),
+        }
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Capture a JAX profiler trace viewable in TensorBoard/Perfetto:

@@ -221,6 +221,13 @@ class CheckpointSaver:
                 return False
         return True
 
+    def reload(self) -> None:
+        """Re-scan the checkpoint directory for steps written by ANOTHER
+        process (serving hot-reload watches a directory a trainer writes
+        to; Orbax caches its step listing per manager)."""
+        if hasattr(self._mngr, "reload"):
+            self._mngr.reload()
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 

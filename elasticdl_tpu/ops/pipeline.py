@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.common.jax_compat import pcast_to_varying, shard_map
 from elasticdl_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 
 
@@ -73,7 +74,7 @@ def _pipeline_local(
         apply_stage = jax.checkpoint(apply_stage)
 
     def varying(v):
-        return lax.pcast(v, (data_axis, pipe_axis), to="varying")
+        return pcast_to_varying(v, (data_axis, pipe_axis))
 
     mb_shape = micro.shape[1:]
     state0 = varying(jnp.zeros(mb_shape, x.dtype))
@@ -161,7 +162,7 @@ def gpipe_spmd(
         remat=remat,
     )
     param_spec = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(param_spec, P(data_axis)),

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from elasticdl_tpu.common.jax_compat import pcast_to_varying, shard_map
 from elasticdl_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
 _NEG_INF = -1e30
@@ -43,7 +44,7 @@ def _ring_attention_local(
     # pcast-to-varying marks them as shard-varying so the scan carry
     # types match the per-shard loop outputs.
     def _varying(x):
-        return jax.lax.pcast(x, varying_axes, to="varying")
+        return pcast_to_varying(x, varying_axes)
 
     m0 = _varying(jnp.full((batch, heads, q_len), _NEG_INF, jnp.float32))
     l0 = _varying(jnp.zeros((batch, heads, q_len), jnp.float32))
@@ -126,7 +127,7 @@ def ring_self_attention(
         )
 
         if flash_shapes_ok(q.shape, k.shape):
-            return jax.shard_map(
+            return shard_map(
                 functools.partial(
                     flash_attention, causal=causal, scale=scale
                 ),
@@ -143,7 +144,7 @@ def ring_self_attention(
         scale=scale,
         varying_axes=(data_axis, seq_axis),
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

@@ -16,8 +16,10 @@ from __future__ import annotations
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
+from elasticdl_tpu.proto import serving_pb2 as spb
 
 SERVICE_NAME = "elasticdl_tpu.Master"
+SERVING_SERVICE_NAME = "elasticdl_tpu.Serving"
 
 # method name -> (request class, response class)
 MASTER_METHODS = {
@@ -44,6 +46,20 @@ METHOD_FAULT_POINTS = {
 }
 
 
+# The online-serving data plane (serving.proto; docs/SERVING.md).
+# `health` carries no fault point: it is the probe used to decide whether
+# to restart a replica, and injecting failures into the prober makes every
+# chaos schedule flap the fleet instead of testing the data path.
+SERVING_METHODS = {
+    "predict": (spb.PredictRequest, spb.PredictResponse),
+    "health": (spb.HealthRequest, spb.HealthResponse),
+}
+
+SERVING_METHOD_FAULT_POINTS = {
+    "predict": faults.POINT_RPC_PREDICT,
+}
+
+
 def method_fault_point_paths() -> dict:
     """Full-path variant ('/elasticdl_tpu.Master/get_task' -> point) for
     the gRPC client interceptor, which only sees method paths."""
@@ -53,31 +69,53 @@ def method_fault_point_paths() -> dict:
     }
 
 
-def add_master_servicer_to_server(servicer, server) -> None:
-    """Register `servicer` (an object with MASTER_METHODS-named methods
-    accepting (request, context)) on a `grpc.Server`."""
+def serving_fault_point_paths() -> dict:
+    return {
+        f"/{SERVING_SERVICE_NAME}/{name}": point
+        for name, point in SERVING_METHOD_FAULT_POINTS.items()
+    }
+
+
+def _add_servicer_to_server(servicer, server, service_name, methods) -> None:
     import grpc
 
     handlers = {}
-    for name, (req_cls, resp_cls) in MASTER_METHODS.items():
+    for name, (req_cls, resp_cls) in methods.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             getattr(servicer, name),
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg, _cls=resp_cls: msg.SerializeToString(),
         )
     server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
     )
 
 
-class MasterStub:
-    """Client stub over a grpc channel; method-for-method mirror of the
-    servicer so `InProcessMasterClient` (direct servicer calls, used by the
-    tests and local mode) and this stub are interchangeable.
+def add_master_servicer_to_server(servicer, server) -> None:
+    """Register `servicer` (an object with MASTER_METHODS-named methods
+    accepting (request, context)) on a `grpc.Server`."""
+    _add_servicer_to_server(servicer, server, SERVICE_NAME, MASTER_METHODS)
+
+
+def add_serving_servicer_to_server(servicer, server) -> None:
+    """Register a serving servicer (predict/health methods accepting
+    (request, context)) on a `grpc.Server`."""
+    _add_servicer_to_server(
+        servicer, server, SERVING_SERVICE_NAME, SERVING_METHODS
+    )
+
+
+class _StubBase:
+    """Builds stub methods from a channel; subclasses pin the service name,
+    method table, and fault-point map.
 
     With `retry_policy`, every method goes through the resilience
     interceptor: per-attempt deadline, exponential backoff + full jitter,
     max-elapsed budget, and per-attempt fault injection."""
+
+    _service_name: str
+    _methods: dict
+    _fault_point_paths: staticmethod
 
     def __init__(self, channel, retry_policy=None):
         if retry_policy is not None:
@@ -90,16 +128,36 @@ class MasterStub:
             channel = grpc.intercept_channel(
                 channel,
                 RetryingClientInterceptor(
-                    retry_policy, fault_points=method_fault_point_paths()
+                    retry_policy, fault_points=type(self)._fault_point_paths()
                 ),
             )
-        for name, (req_cls, resp_cls) in MASTER_METHODS.items():
+        for name, (req_cls, resp_cls) in self._methods.items():
             callable_ = channel.unary_unary(
-                f"/{SERVICE_NAME}/{name}",
+                f"/{self._service_name}/{name}",
                 request_serializer=req_cls.SerializeToString,
                 response_deserializer=resp_cls.FromString,
             )
             setattr(self, name, _StripContext(callable_))
+
+
+class MasterStub(_StubBase):
+    """Client stub over a grpc channel; method-for-method mirror of the
+    servicer so `InProcessMasterClient` (direct servicer calls, used by the
+    tests and local mode) and this stub are interchangeable."""
+
+    _service_name = SERVICE_NAME
+    _methods = MASTER_METHODS
+    _fault_point_paths = staticmethod(method_fault_point_paths)
+
+
+class ServingStub(_StubBase):
+    """Client stub for the Serving data plane; interchangeable with
+    `InProcessServingClient` the same way MasterStub mirrors its
+    in-process twin."""
+
+    _service_name = SERVING_SERVICE_NAME
+    _methods = SERVING_METHODS
+    _fault_point_paths = staticmethod(serving_fault_point_paths)
 
 
 class _StripContext:
@@ -113,16 +171,17 @@ class _StripContext:
         return self._callable(request, timeout=timeout)
 
 
-class InProcessMasterClient:
-    """Calls a MasterServicer directly, no sockets.  Used by tests and by
-    `--distribution_strategy=Local` where master and worker share a process
-    (the reference exercises its protocol the same way in
-    worker_ps_interaction_test.py — SURVEY.md §4.2)."""
+class _InProcessClientBase:
+    """Calls a servicer directly, no sockets; subclasses pin the method
+    table and fault-point map."""
+
+    _methods: dict
+    _fault_points: dict
 
     def __init__(self, servicer, retry_policy=None):
-        for name in MASTER_METHODS:
+        for name in self._methods:
             method = getattr(servicer, name)
-            point = METHOD_FAULT_POINTS.get(name)
+            point = self._fault_points.get(name)
             call = self._make_call(method, point, retry_policy, name)
             setattr(self, name, call)
 
@@ -138,3 +197,20 @@ class InProcessMasterClient:
         return lambda request, timeout=None: retry_policy.call(
             lambda: _attempt(request), description=name
         )
+
+
+class InProcessMasterClient(_InProcessClientBase):
+    """Calls a MasterServicer directly, no sockets.  Used by tests and by
+    `--distribution_strategy=Local` where master and worker share a process
+    (the reference exercises its protocol the same way in
+    worker_ps_interaction_test.py — SURVEY.md §4.2)."""
+
+    _methods = MASTER_METHODS
+    _fault_points = METHOD_FAULT_POINTS
+
+
+class InProcessServingClient(_InProcessClientBase):
+    """Direct-call twin of ServingStub for tests and in-process benches."""
+
+    _methods = SERVING_METHODS
+    _fault_points = SERVING_METHOD_FAULT_POINTS

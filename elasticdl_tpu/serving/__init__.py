@@ -1,0 +1,7 @@
+"""TPU-native online serving: bucketed jit engine, dynamic micro-batcher,
+gRPC front-end, zero-downtime checkpoint hot-reload.  See docs/SERVING.md.
+
+Import the submodules directly (`serving.engine`, `serving.batcher`,
+`serving.server`, `serving.reloader`) — this package init stays
+import-light so the batcher can be unit-tested without grpc/protobuf.
+"""

@@ -1,0 +1,357 @@
+"""Dynamic micro-batcher: admission control + batch assembly for serving.
+
+Requests land on a bounded row queue; a single dispatch thread gathers
+them into the largest batch that fits a bucket, cutting either when
+`max_batch` rows are ready or when the OLDEST queued request has waited
+`max_latency_s` (latency cutoff beats fill: an idle service answers a
+lone request within one deadline, never waiting for traffic that may not
+come).  The engine pads the gathered rows to the nearest bucket, so the
+batch-fill ratio (`rows / bucket`) is the efficiency metric — exported
+through health and the serving bench.
+
+Overload policy is shed-at-admission: when the queue is full the request
+completes IMMEDIATELY with OVERLOADED instead of queueing into a
+deadline it cannot meet.  Clients see an explicit in-band status
+(serving.proto ServingCode) and can back off; latency of accepted
+requests stays bounded.
+
+Oversized requests (rows > largest bucket) are split into bucket-sized
+chunks that ride the queue independently and re-assemble on completion —
+or are rejected up front with INVALID when `reject_oversized` is set
+(deployments that want clients to respect the contract).
+
+Shutdown drains: queued requests complete, then later submissions get
+SHUTTING_DOWN.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.profiler import LatencyHistogram
+
+logger = get_logger(__name__)
+
+# In-band status codes, value-for-value the serving.proto ServingCode
+# enum (the proto module stays optional here: the batcher is usable —
+# and unit-tested — without grpc/protobuf in the process).
+OK = 0
+OVERLOADED = 1
+SHUTTING_DOWN = 2
+INVALID = 3
+INTERNAL = 4
+
+
+@dataclass
+class ServingResult:
+    """What a submission resolves to; maps 1:1 onto PredictResponse."""
+
+    code: int
+    error: str = ""
+    predictions: Optional[np.ndarray] = None
+    model_step: int = 0
+
+
+@dataclass
+class _Item:
+    features: Dict[str, np.ndarray]
+    rows: int
+    future: Future
+    enqueued_at: float
+    # for split oversized requests: (aggregate, chunk_index)
+    aggregate: Optional["_Aggregate"] = None
+    chunk_index: int = 0
+
+
+@dataclass
+class _Aggregate:
+    """Re-assembles a split oversized request in chunk order."""
+
+    future: Future
+    pending: int
+    chunks: list = field(default_factory=list)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def complete_chunk(self, index: int, result: ServingResult) -> None:
+        with self.lock:
+            self.chunks.append((index, result))
+            self.pending -= 1
+            if self.pending > 0:
+                return
+            chunks = sorted(self.chunks)
+        failed = [r for _, r in chunks if r.code != OK]
+        if failed:
+            self.future.set_result(failed[0])
+            return
+        self.future.set_result(ServingResult(
+            code=OK,
+            predictions=np.concatenate(
+                [r.predictions for _, r in chunks], axis=0
+            ),
+            model_step=min(r.model_step for _, r in chunks),
+        ))
+
+
+def _resolved(code: int, error: str = "") -> Future:
+    f = Future()
+    f.set_result(ServingResult(code=code, error=error))
+    return f
+
+
+class BatcherMetrics:
+    """Thread-safe counters + latency histogram; snapshot() feeds the
+    Health RPC and the serving bench."""
+
+    def __init__(self):
+        self.latency = LatencyHistogram()
+        self._lock = threading.Lock()
+        self._ok_rows = 0
+        self._shed = 0
+        self._invalid = 0
+        self._internal = 0
+        self._batches = 0
+        self._fill_sum = 0.0
+
+    def record_batch(self, rows: int, bucket: int) -> None:
+        with self._lock:
+            self._batches += 1
+            self._ok_rows += rows
+            self._fill_sum += rows / bucket
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self._shed += 1
+
+    def record_invalid(self) -> None:
+        with self._lock:
+            self._invalid += 1
+
+    def record_internal(self) -> None:
+        with self._lock:
+            self._internal += 1
+
+    def snapshot(self) -> dict:
+        lat = self.latency.snapshot()
+        with self._lock:
+            fill = self._fill_sum / self._batches if self._batches else 0.0
+            return {
+                "ok_rows": float(self._ok_rows),
+                "batches": float(self._batches),
+                "batch_fill_ratio": fill,
+                "shed": float(self._shed),
+                "invalid": float(self._invalid),
+                "internal": float(self._internal),
+                "latency_p50_s": lat["p50_s"],
+                "latency_p99_s": lat["p99_s"],
+                "latency_mean_s": lat["mean_s"],
+            }
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        engine,
+        max_latency_s: float = 0.01,
+        max_batch: Optional[int] = None,
+        max_queue_rows: Optional[int] = None,
+        reject_oversized: bool = False,
+        clock=time.monotonic,
+    ):
+        self._engine = engine
+        self._max_latency_s = float(max_latency_s)
+        self._max_batch = int(max_batch or engine.max_bucket)
+        if self._max_batch > engine.max_bucket:
+            raise ValueError(
+                f"max_batch={self._max_batch} exceeds largest engine "
+                f"bucket {engine.max_bucket}"
+            )
+        # default queue bound: a few full batches of headroom — deep
+        # queues only convert overload into latency, never into goodput
+        self._max_queue_rows = int(
+            max_queue_rows if max_queue_rows is not None
+            else 4 * self._max_batch
+        )
+        self._reject_oversized = reject_oversized
+        self._clock = clock
+        self.metrics = BatcherMetrics()
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="serving-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # ---- submission -----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        """Rows currently queued (health metric)."""
+        with self._cond:
+            return self._queued_rows
+
+    def submit(self, features: Dict[str, np.ndarray]) -> Future:
+        """Returns a Future resolving to ServingResult.  Never raises and
+        never blocks: invalid/overload/shutdown resolve immediately."""
+        error = self._engine.validate(features)
+        if error is not None:
+            self.metrics.record_invalid()
+            return _resolved(INVALID, error)
+        rows = int(next(iter(features.values())).shape[0])
+        if rows > self._max_batch:
+            if self._reject_oversized:
+                self.metrics.record_invalid()
+                return _resolved(
+                    INVALID,
+                    f"request of {rows} rows exceeds the batch limit "
+                    f"{self._max_batch} "
+                    "(oversized requests are rejected by policy)",
+                )
+            return self._submit_split(features, rows)
+        return self._enqueue(features, rows)
+
+    def _submit_split(self, features, rows: int) -> Future:
+        chunk = self._max_batch
+        n_chunks = (rows + chunk - 1) // chunk
+        agg = _Aggregate(future=Future(), pending=n_chunks)
+        # admission-check the WHOLE request before enqueuing any chunk:
+        # partially admitting an oversized request sheds its own tail
+        with self._cond:
+            if self._stopped:
+                return _resolved(SHUTTING_DOWN, "server is shutting down")
+            if self._queued_rows + rows > self._max_queue_rows:
+                self.metrics.record_shed()
+                return _resolved(
+                    OVERLOADED,
+                    f"queue full ({self._queued_rows} rows queued)",
+                )
+            now = self._clock()
+            for i in range(n_chunks):
+                lo, hi = i * chunk, min((i + 1) * chunk, rows)
+                part = {k: v[lo:hi] for k, v in features.items()}
+                item = _Item(
+                    features=part, rows=hi - lo, future=Future(),
+                    enqueued_at=now, aggregate=agg, chunk_index=i,
+                )
+                self._queue.append(item)
+                self._queued_rows += item.rows
+            self._cond.notify()
+        return agg.future
+
+    def _enqueue(self, features, rows: int) -> Future:
+        with self._cond:
+            if self._stopped:
+                return _resolved(SHUTTING_DOWN, "server is shutting down")
+            if self._queued_rows + rows > self._max_queue_rows:
+                self.metrics.record_shed()
+                return _resolved(
+                    OVERLOADED,
+                    f"queue full ({self._queued_rows} rows queued)",
+                )
+            item = _Item(
+                features=features, rows=rows, future=Future(),
+                enqueued_at=self._clock(),
+            )
+            self._queue.append(item)
+            self._queued_rows += rows
+            self._cond.notify()
+            return item.future
+
+    # ---- dispatch -------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return  # stopped and drained
+            self._execute(batch)
+
+    def _gather(self):
+        """Block until a batch is due: max_batch rows ready, or the
+        oldest request's latency deadline has passed, or shutdown."""
+        with self._cond:
+            while True:
+                if self._queue:
+                    deadline = (
+                        self._queue[0].enqueued_at + self._max_latency_s
+                    )
+                    if (
+                        self._queued_rows >= self._max_batch
+                        or self._clock() >= deadline
+                        or self._stopped  # draining: don't wait out
+                    ):                    # deadlines nobody benefits from
+                        return self._pop_batch()
+                    self._cond.wait(
+                        timeout=max(0.0, deadline - self._clock())
+                    )
+                elif self._stopped:
+                    return None
+                else:
+                    self._cond.wait()
+
+    def _pop_batch(self):
+        """Called under the lock: pop queued items that fit max_batch."""
+        batch, rows = [], 0
+        while self._queue and rows + self._queue[0].rows <= self._max_batch:
+            item = self._queue.popleft()
+            rows += item.rows
+            batch.append(item)
+        self._queued_rows -= rows
+        return batch
+
+    def _execute(self, batch) -> None:
+        rows = sum(item.rows for item in batch)
+        features = {
+            k: np.concatenate(
+                [np.asarray(item.features[k]) for item in batch], axis=0
+            )
+            for k in batch[0].features
+        }
+        try:
+            preds, step = self._engine.predict(features, rows)
+        except Exception as exc:  # engine failure: fail THIS batch only
+            logger.exception("serving batch execution failed")
+            self.metrics.record_internal()
+            for item in batch:
+                self._finish(item, ServingResult(
+                    code=INTERNAL, error=f"execution failed: {exc}",
+                ))
+            return
+        bucket = self._engine.bucket_for(rows)
+        self.metrics.record_batch(rows, bucket)
+        now = self._clock()
+        offset = 0
+        for item in batch:
+            self.metrics.latency.record(max(0.0, now - item.enqueued_at))
+            self._finish(item, ServingResult(
+                code=OK,
+                predictions=preds[offset:offset + item.rows],
+                model_step=step,
+            ))
+            offset += item.rows
+
+    @staticmethod
+    def _finish(item: _Item, result: ServingResult) -> None:
+        if item.aggregate is not None:
+            item.aggregate.complete_chunk(item.chunk_index, result)
+        else:
+            item.future.set_result(result)
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def shutdown(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting work, drain everything queued, stop the
+        dispatch thread.  Idempotent."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)

@@ -1,0 +1,123 @@
+"""Zero-downtime checkpoint hot-reload for the serving engine.
+
+A trainer keeps writing steps into its checkpoint directory; the
+reloader watches that directory and swaps the serving engine onto newer
+steps with the double-buffer discipline:
+
+1. restore the candidate step into FRESH host buffers (the served
+   variables are untouched — both generations coexist briefly);
+2. gate on the integrity manifest (save_utils.verify_step) — a
+   truncated or bit-flipped checkpoint never reaches the engine;
+3. `engine.swap()` atomically republishes the reference.  In-flight
+   batches finish on the generation they already read, so no request is
+   dropped or served a half-loaded tree.
+
+Any failure — injected (faults.POINT_SERVING_RELOAD), integrity, or a
+real restore error — leaves the engine on its current params and is
+counted in `rejected_count`; the SAME step is never retried (a corrupt
+step stays corrupt; retrying would melt the poll loop), but newer steps
+are still considered.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.worker.trainer import run_device_serialized
+
+logger = get_logger(__name__)
+
+
+class CheckpointReloader:
+    def __init__(
+        self,
+        engine,
+        checkpoint_dir: str,
+        template: Any = None,
+        poll_interval_s: float = 1.0,
+    ):
+        template = template if template is not None \
+            else engine.state_template
+        if template is None:
+            raise ValueError(
+                "reloader needs the abstract TrainState template the "
+                "checkpoints restore into — build the engine with "
+                "ServingEngine.from_checkpoint, or pass template= "
+                "(serving/engine.py build_state_template)"
+            )
+        self._engine = engine
+        self._template = template
+        self._saver = CheckpointSaver(checkpoint_dir, async_save=False)
+        self._poll_interval_s = poll_interval_s
+        self._rejected_steps = set()
+        self.reload_count = 0
+        self.rejected_count = 0
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> bool:
+        """One poll: swap to the newest verified step if it is newer than
+        what the engine serves.  True on a successful swap."""
+        self._saver.reload()
+        latest = self._saver.latest_step()
+        if latest is None or latest <= self._engine.step \
+                or latest in self._rejected_steps:
+            return False
+        try:
+            faults.fire(faults.POINT_SERVING_RELOAD)
+            if not self._saver.verify_step(latest):
+                raise RuntimeError(
+                    f"step {latest} failed integrity verification"
+                )
+            restored = run_device_serialized(
+                self._saver.restore_step, latest, self._template
+            )
+            if restored is None:
+                raise RuntimeError(f"step {latest} could not be restored")
+            self._engine.swap(
+                {**restored.params, **restored.model_state}, latest
+            )
+        except Exception as exc:
+            self._rejected_steps.add(latest)
+            self.rejected_count += 1
+            self.last_error = str(exc)
+            logger.warning(
+                "hot-reload of step %d rejected (%s); still serving "
+                "step %d", latest, exc, self._engine.step,
+            )
+            return False
+        self.reload_count += 1
+        self.last_error = None
+        return True
+
+    # ---- poll thread ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="serving-reloader", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                # the poll loop must survive anything — serving continues
+                # on current params no matter what the watcher hits
+                logger.exception("reloader poll failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        self._saver.close()

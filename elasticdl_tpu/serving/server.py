@@ -1,0 +1,198 @@
+"""gRPC front-end for the serving subsystem (serving.proto).
+
+ServingServicer translates between the wire (PredictRequest /
+PredictResponse, raw-bytes tensors) and the batcher's
+ServingResult — it holds NO serving logic beyond decode/encode, so the
+in-process client (proto/service.py InProcessServingClient) and a real
+socket exercise identical code.  Status rides in-band as ServingCode:
+overload/shutdown are expected outcomes, not transport failures (see
+serving.proto).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.export import SINGLE_FEATURE_KEY
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.serving import batcher as batcher_lib
+
+logger = get_logger(__name__)
+
+# ServingResult.code values coincide with the proto enum by construction
+# (batcher.py) — asserted here so a drift in either is an import error,
+# not a wrong status on the wire.
+assert batcher_lib.OK == spb.SERVING_OK
+assert batcher_lib.OVERLOADED == spb.SERVING_OVERLOADED
+assert batcher_lib.SHUTTING_DOWN == spb.SERVING_SHUTTING_DOWN
+assert batcher_lib.INVALID == spb.SERVING_INVALID
+assert batcher_lib.INTERNAL == spb.SERVING_INTERNAL
+
+
+def to_tensor_proto(arr: np.ndarray) -> spb.TensorProto:
+    arr = np.ascontiguousarray(arr)
+    return spb.TensorProto(
+        dtype=str(arr.dtype),
+        shape=list(arr.shape),
+        data=arr.tobytes(),
+    )
+
+
+def from_tensor_proto(tp: spb.TensorProto) -> np.ndarray:
+    """Decode a wire tensor; raises ValueError with a client-facing
+    message on anything malformed (mapped to SERVING_INVALID)."""
+    try:
+        dtype = np.dtype(tp.dtype)
+    except TypeError:
+        raise ValueError(f"unknown tensor dtype {tp.dtype!r}")
+    if dtype.hasobject:
+        raise ValueError(f"object dtype {tp.dtype!r} is not servable")
+    shape = tuple(int(d) for d in tp.shape)
+    if any(d < 0 for d in shape):
+        raise ValueError(f"negative dimension in shape {shape}")
+    expected = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if len(tp.data) != expected:
+        raise ValueError(
+            f"tensor data is {len(tp.data)} bytes but shape {shape} "
+            f"dtype {dtype} needs {expected}"
+        )
+    return np.frombuffer(tp.data, dtype=dtype).reshape(shape)
+
+
+def decode_features(request: spb.PredictRequest) -> dict:
+    if not request.inputs:
+        raise ValueError("request has no input tensors")
+    features = {}
+    for named in request.inputs:
+        if not named.name:
+            raise ValueError("input tensor with empty name")
+        if named.name in features:
+            raise ValueError(f"duplicate input tensor {named.name!r}")
+        features[named.name] = from_tensor_proto(named.tensor)
+    return features
+
+
+def make_predict_request(features) -> spb.PredictRequest:
+    """Client-side helper: dict of arrays (or one bare array, sent under
+    the single-input key) -> PredictRequest."""
+    if not isinstance(features, dict):
+        features = {SINGLE_FEATURE_KEY: features}
+    request = spb.PredictRequest()
+    for name, arr in features.items():
+        named = request.inputs.add()
+        named.name = str(name)
+        named.tensor.CopyFrom(to_tensor_proto(np.asarray(arr)))
+    return request
+
+
+class ServingServicer:
+    """predict/health handlers; register with
+    proto.service.add_serving_servicer_to_server or call directly via
+    InProcessServingClient."""
+
+    def __init__(self, engine, batcher, reloader=None,
+                 request_timeout_s: float = 30.0):
+        self._engine = engine
+        self._batcher = batcher
+        self._reloader = reloader
+        self._request_timeout_s = request_timeout_s
+
+    def predict(self, request, context) -> spb.PredictResponse:
+        try:
+            features = decode_features(request)
+        except ValueError as exc:
+            return spb.PredictResponse(
+                code=spb.SERVING_INVALID, error=str(exc)
+            )
+        result = self._batcher.submit(features).result(
+            timeout=self._request_timeout_s
+        )
+        response = spb.PredictResponse(
+            code=result.code, error=result.error,
+            model_step=result.model_step,
+        )
+        if result.predictions is not None:
+            response.predictions.CopyFrom(
+                to_tensor_proto(result.predictions)
+            )
+        return response
+
+    def health(self, request, context) -> spb.HealthResponse:
+        response = spb.HealthResponse(
+            serving=True,
+            model_step=self._engine.step,
+            buckets=list(self._engine.buckets),
+            queue_depth=self._batcher.queue_depth,
+            compile_count=self._engine.compile_count,
+        )
+        metrics = dict(self._batcher.metrics.snapshot())
+        metrics["swap_count"] = float(self._engine.swap_count)
+        if self._reloader is not None:
+            metrics["reload_count"] = float(self._reloader.reload_count)
+            metrics["reload_rejected"] = float(
+                self._reloader.rejected_count
+            )
+        for name in sorted(metrics):
+            m = response.metrics.add()
+            m.name = name
+            m.value = float(metrics[name])
+        return response
+
+
+class ServingServer:
+    """Owns the grpc.Server plus the batcher/reloader lifecycle."""
+
+    def __init__(self, engine, batcher, reloader=None, workers: int = 16,
+                 request_timeout_s: float = 30.0):
+        self._engine = engine
+        self._batcher = batcher
+        self._reloader = reloader
+        self.servicer = ServingServicer(
+            engine, batcher, reloader,
+            request_timeout_s=request_timeout_s,
+        )
+        self._workers = workers
+        self._server = None
+        self.port: Optional[int] = None
+
+    def start(self, port: int = 0) -> int:
+        """Bind (port 0 = ephemeral), start serving; returns the port."""
+        import grpc
+        from concurrent import futures as _futures
+
+        from elasticdl_tpu.proto.service import (
+            add_serving_servicer_to_server,
+        )
+
+        self._server = grpc.server(
+            _futures.ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix="serving-rpc",
+            )
+        )
+        add_serving_servicer_to_server(self.servicer, self._server)
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"could not bind serving port {port}")
+        if self._reloader is not None:
+            self._reloader.start()
+        self._server.start()
+        logger.info("serving on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 5.0) -> None:
+        """Drain order: stop intake (gRPC), drain the batcher, stop the
+        reloader — queued requests complete before the process exits."""
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        self._batcher.shutdown()
+        if self._reloader is not None:
+            self._reloader.stop()
+
+    def wait(self) -> None:
+        if self._server is not None:
+            self._server.wait_for_termination()

@@ -37,6 +37,7 @@ import jax
 import numpy as np
 
 from elasticdl_tpu.common import resilience
+from elasticdl_tpu.common.jax_compat import distributed_is_initialized
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_handler import ModelSpec
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -227,7 +228,7 @@ class SPMDWorker:
             # be saved by the watchdog restarting the process.
             self._watchdog_started = True
             threading.Thread(target=self._watchdog, daemon=True).start()
-        if self.num_processes > 1 and not jax.distributed.is_initialized():
+        if self.num_processes > 1 and not distributed_is_initialized():
             jax.distributed.initialize(
                 coordinator_address=self._coordinator,
                 num_processes=self.num_processes,
@@ -809,7 +810,7 @@ class SPMDWorker:
         # that confirmed the new epoch and THEN exited would release the
         # barrier for fresh joiners, who would initialize a world whose
         # members are already gone and wedge until their watchdogs fire.
-        if jax.distributed.is_initialized() or self.num_processes > 1:
+        if distributed_is_initialized() or self.num_processes > 1:
             self._restart_for_topology_change()
         self._recovery_t0 = time.time()
         # Peek (no confirmation) at the new spec: a single-process worker

@@ -1,0 +1,196 @@
+"""DynamicBatcher unit tests against a fake engine — batching policy,
+admission control, oversized handling, shutdown semantics.  No jax on
+the hot path, so these run in milliseconds."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.serving.batcher import (
+    INTERNAL,
+    INVALID,
+    OK,
+    OVERLOADED,
+    SHUTTING_DOWN,
+    DynamicBatcher,
+)
+
+
+class FakeEngine:
+    """ServingEngine's batcher-facing surface: buckets, validate,
+    predict.  Predictions echo a running row counter so tests can check
+    per-request row alignment through concat/split."""
+
+    def __init__(self, buckets=(4, 8), delay_s=0.0, fail=False):
+        self._buckets = tuple(sorted(buckets))
+        self.delay_s = delay_s
+        self.fail = fail
+        self.calls = []          # (rows, bucket) per predict
+        self.entered = threading.Event()  # set when predict is reached
+        self.release = threading.Event()
+        self.release.set()
+        self._next_row = 0
+        self._lock = threading.Lock()
+
+    @property
+    def max_bucket(self):
+        return self._buckets[-1]
+
+    def bucket_for(self, rows):
+        for b in self._buckets:
+            if b >= rows:
+                return b
+        return None
+
+    def validate(self, features):
+        if set(features) != {"x"}:
+            return f"feature keys {sorted(features)} do not match ['x']"
+        if features["x"].shape[0] == 0:
+            return "empty request (0 rows)"
+        return None
+
+    def predict(self, features, rows):
+        self.entered.set()
+        self.release.wait(timeout=10)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("engine exploded")
+        with self._lock:
+            self.calls.append((rows, self.bucket_for(rows)))
+            start = self._next_row
+            self._next_row += rows
+        return np.arange(start, start + rows, dtype=np.int64), 7
+
+
+def _req(rows):
+    return {"x": np.zeros((rows, 3), np.float32)}
+
+
+@pytest.fixture
+def engine():
+    return FakeEngine()
+
+
+def test_single_request_dispatches_at_deadline(engine):
+    """Empty-queue deadline expiry: a lone request must not wait for
+    batch-mates that never arrive — it dispatches once its latency
+    budget elapses, alone in the batch."""
+    batcher = DynamicBatcher(engine, max_latency_s=0.05)
+    t0 = time.monotonic()
+    result = batcher.submit(_req(1)).result(timeout=5)
+    elapsed = time.monotonic() - t0
+    assert result.code == OK
+    assert result.model_step == 7
+    assert result.predictions.shape == (1,)
+    # it waited out the deadline (nothing else queued), then ran
+    assert 0.04 <= elapsed < 2.0
+    assert engine.calls == [(1, 4)]
+    batcher.shutdown()
+
+
+def test_full_batch_dispatches_before_deadline(engine):
+    """Rows cutoff: max_batch queued rows dispatch immediately even with
+    a deadline far in the future."""
+    engine.release.clear()  # hold the dispatcher so the queue fills
+    batcher = DynamicBatcher(engine, max_latency_s=30.0, max_batch=8)
+    futures = [batcher.submit(_req(2)) for _ in range(4)]
+    engine.release.set()
+    t0 = time.monotonic()
+    results = [f.result(timeout=5) for f in futures]
+    assert time.monotonic() - t0 < 5.0  # nowhere near the 30s deadline
+    assert [r.code for r in results] == [OK] * 4
+    # one batch of 8 rows, split back 2 rows each, in order
+    assert engine.calls == [(8, 8)]
+    flat = np.concatenate([r.predictions for r in results])
+    np.testing.assert_array_equal(flat, np.arange(8))
+    batcher.shutdown()
+
+
+def test_overload_sheds_immediately(engine):
+    engine.release.clear()  # engine stalled: queue can only grow
+    batcher = DynamicBatcher(
+        engine, max_latency_s=0.001, max_queue_rows=4
+    )
+    admitted = [batcher.submit(_req(2))]
+    # wait until the dispatcher is INSIDE predict (stalled) so the next
+    # two submissions deterministically sit in the queue, filling it
+    assert engine.entered.wait(timeout=5)
+    admitted += [batcher.submit(_req(2)) for _ in range(2)]
+    shed = batcher.submit(_req(2))
+    # shed resolves without waiting for the engine
+    result = shed.result(timeout=1)
+    assert result.code == OVERLOADED
+    assert "queue full" in result.error
+    assert batcher.metrics.snapshot()["shed"] == 1.0
+    engine.release.set()
+    assert [f.result(timeout=5).code for f in admitted] == [OK] * 3
+    batcher.shutdown()
+
+
+def test_oversized_request_splits_and_reassembles(engine):
+    batcher = DynamicBatcher(engine, max_latency_s=0.005)
+    # 18 rows > max bucket 8 -> chunks of 8+8+2, reassembled in order
+    result = batcher.submit(_req(18)).result(timeout=5)
+    assert result.code == OK
+    assert result.predictions.shape == (18,)
+    np.testing.assert_array_equal(result.predictions, np.arange(18))
+    batcher.shutdown()
+
+
+def test_oversized_request_rejected_by_policy(engine):
+    batcher = DynamicBatcher(
+        engine, max_latency_s=0.005, reject_oversized=True
+    )
+    result = batcher.submit(_req(18)).result(timeout=1)
+    assert result.code == INVALID
+    assert "exceeds the batch limit" in result.error
+    assert engine.calls == []
+    batcher.shutdown()
+
+
+def test_invalid_request_resolves_without_engine(engine):
+    batcher = DynamicBatcher(engine, max_latency_s=0.005)
+    result = batcher.submit({"y": np.zeros((1, 3))}).result(timeout=1)
+    assert result.code == INVALID
+    assert "feature keys" in result.error
+    assert engine.calls == []
+    batcher.shutdown()
+
+
+def test_shutdown_drains_in_flight_then_rejects(engine):
+    engine.delay_s = 0.02  # slow engine: work is queued at shutdown
+    batcher = DynamicBatcher(engine, max_latency_s=0.001, max_batch=4)
+    futures = [batcher.submit(_req(3)) for _ in range(5)]
+    batcher.shutdown()
+    # everything admitted before shutdown completed OK
+    assert [f.result(timeout=1).code for f in futures] == [OK] * 5
+    # and the door is now closed
+    late = batcher.submit(_req(1)).result(timeout=1)
+    assert late.code == SHUTTING_DOWN
+
+
+def test_engine_failure_fails_batch_not_batcher(engine):
+    batcher = DynamicBatcher(engine, max_latency_s=0.005)
+    engine.fail = True
+    result = batcher.submit(_req(2)).result(timeout=5)
+    assert result.code == INTERNAL
+    assert "engine exploded" in result.error
+    engine.fail = False  # the dispatcher survived; next batch succeeds
+    assert batcher.submit(_req(2)).result(timeout=5).code == OK
+    assert batcher.metrics.snapshot()["internal"] == 1.0
+    batcher.shutdown()
+
+
+def test_metrics_fill_ratio_and_latency(engine):
+    batcher = DynamicBatcher(engine, max_latency_s=0.01)
+    assert batcher.submit(_req(2)).result(timeout=5).code == OK
+    snap = batcher.metrics.snapshot()
+    assert snap["batches"] == 1.0
+    assert snap["ok_rows"] == 2.0
+    assert snap["batch_fill_ratio"] == pytest.approx(0.5)  # 2 of bucket 4
+    assert snap["latency_p99_s"] > 0.0
+    assert batcher.queue_depth == 0
+    batcher.shutdown()
