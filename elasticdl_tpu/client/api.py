@@ -158,6 +158,7 @@ def _train_local(args, job_type: str = "train") -> int:
             model_owner=owner,
             steps_per_execution=getattr(args, "steps_per_execution", 1),
             compact_wire=getattr(args, "compact_wire", False),
+            wire_format=getattr(args, "wire_format", ""),
             tensorboard_dir=tb_dir,
             # one process, one profiler: only worker 0 may trace
             profile_dir=(

@@ -220,6 +220,16 @@ def add_train_params(parser: argparse.ArgumentParser):
         "provides one — fewer host->device bytes per example on "
         "bandwidth-limited links",
     )
+    parser.add_argument(
+        "--wire_format", default="", choices=["", "plain", "compact", "dedup"],
+        help="host->device wire format: plain (feed_bulk), compact "
+        "(feed_bulk_compact, same as --compact_wire=true), or dedup "
+        "(feed_bulk_dedup — host-hashed ids dedup'd per field into "
+        "frequency-ranked uniques + a 1-byte inverse plane; fewest "
+        "bytes/example on skewed id streams).  Empty defers to "
+        "--compact_wire.  SPMD slice-local sharding ignores 'dedup' "
+        "(per-rank unique counts diverge -> collective shape mismatch)",
+    )
     parser.add_argument("--data_reader_params", default="")
     parser.add_argument("--records_per_task", type=pos_int, default=4096)
     parser.add_argument(

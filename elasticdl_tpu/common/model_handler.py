@@ -20,6 +20,13 @@ model definitions port by re-implementing bodies in Flax/Optax:
                                  wire format — elasticdl_tpu.data.wire —
                                  selected by --compact_wire; the model
                                  must accept the compact dtypes)
+    feed_bulk_dedup(buffer, sizes, metadata) -> batch dict (optional;
+                                 feed_bulk in the dedup'd device wire
+                                 format — ids hashed host-side and
+                                 shipped as frequency-ranked uniques +
+                                 1-byte inverse (wire.pack_rows_dedup);
+                                 selected by --wire_format=dedup; the
+                                 model must consume prehashed rows)
     param_sharding(path,leaf) -> PartitionSpec | None (optional; TPU-native
                                  extension for sharded embeddings / TP)
 
@@ -52,6 +59,7 @@ class ModelSpec:
     feed: Callable
     feed_bulk: Optional[Callable] = None
     feed_bulk_compact: Optional[Callable] = None
+    feed_bulk_dedup: Optional[Callable] = None
     eval_metrics: Dict[str, Callable] = field(default_factory=dict)
     custom_data_reader: Optional[Callable] = None
     callbacks: list = field(default_factory=list)
@@ -60,6 +68,42 @@ class ModelSpec:
     # invoked on each prediction batch (e.g. streaming rows to a sink)
     prediction_outputs_processor: Any = None
     module: Any = None
+
+
+def resolve_wire_format(
+    spec: "ModelSpec", wire_format: str = "", compact_wire: bool = False,
+    log=logger,
+) -> str:
+    """Pick the batch wire format a worker will actually run.
+
+    --wire_format wins; empty defers to the legacy --compact_wire bool.
+    A requested format the zoo doesn't implement degrades to the
+    next-best one it does (dedup -> compact -> plain), with a warning —
+    mirroring the original --compact_wire fallback so a job never dies
+    over a missing optional feed."""
+    requested = (wire_format or "").strip().lower() or (
+        "compact" if compact_wire else "plain"
+    )
+    if requested not in ("plain", "compact", "dedup"):
+        raise ValueError(
+            f"unknown wire format {requested!r}; "
+            "expected plain | compact | dedup"
+        )
+    resolved = requested
+    if resolved == "dedup" and spec.feed_bulk_dedup is None:
+        log.warning(
+            "--wire_format=dedup requested but the zoo module defines no "
+            "feed_bulk_dedup; falling back"
+        )
+        resolved = "compact"
+    if resolved == "compact" and spec.feed_bulk_compact is None:
+        if requested == "compact":
+            log.warning(
+                "--compact_wire requested but the zoo module defines no "
+                "feed_bulk_compact; using the standard feed"
+            )
+        resolved = "plain"
+    return resolved
 
 
 def load_module(model_zoo: str, dotted: str):
@@ -140,6 +184,7 @@ def get_model_spec(
         feed=opt(dataset_fn),
         feed_bulk=opt("feed_bulk", required=False),
         feed_bulk_compact=opt("feed_bulk_compact", required=False),
+        feed_bulk_dedup=opt("feed_bulk_dedup", required=False),
         eval_metrics=metrics_factory() if metrics_factory else {},
         custom_data_reader=reader_factory,
         callbacks=callbacks_factory() if callbacks_factory else [],

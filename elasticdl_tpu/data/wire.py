@@ -133,3 +133,204 @@ def is_packed_uint24(arr) -> bool:
         and arr.ndim >= 2
         and arr.shape[-1] == 3
     )
+
+
+# ---------------------------------------------------------------------------
+# Dedup'd id plane: frequency-ranked uniques + a uint8 inverse (PFOR-style)
+# ---------------------------------------------------------------------------
+#
+# CTR id streams are zipf-skewed: a 65536-row batch of 26 fields carries
+# ~1.7M ids but only ~40-60K distinct values, and ~95% of draws in each
+# field hit that field's top-254 values.  Shipping the ids themselves —
+# even b22-packed at 2.75 B/id — moves every duplicate across the
+# host->device link.  This format ships each field's DISTINCT table rows
+# once plus a 1-byte-per-id inverse:
+#
+#   unique   (U_pad,)  uint32  per-field frequency-ranked unique rows,
+#                              concatenated in field order
+#   starts   (F,)      int32   field f's offset into `unique`
+#   inverse8 (B, F)    uint8   per-field frequency rank; DEDUP_ESCAPE
+#                              (255) marks a cold id
+#   exc_val  (E_pad,)  uint16/uint32  true ranks of the escaped
+#                              positions, in row-major scan order of
+#                              (B, F) (uint16 iff B <= 65536 — rank <
+#                              U_f <= B)
+#
+# Escape POSITIONS are never shipped: `inverse8 == 255` already marks
+# them, so the device recovers each escape's index into `exc_val` with a
+# cumsum over the escape mask (exclusive prefix count) — a gather, not a
+# scatter, and ~6 B/example cheaper on the link than an explicit
+# position plane.
+#
+# The values in `unique` are PRE-HASHED table rows (hash_ids_host /
+# arena_rows_host run in the prefetch thread), so the device-side
+# reconstruction is one mask-cumsum + two gathers and the embedding
+# consumes rows directly (DistributedEmbedding prehashed=True, skipping
+# the on-device hash/mod).  Padding keeps shapes static under jit:
+# `DedupPacker` grows its pad caps monotonically (quantum-rounded with
+# headroom), so consecutive batches share shapes — the contract
+# steps_per_execution's np.stack grouping relies on.
+
+DEDUP_ESCAPE = 255
+DEDUP_KEYS = frozenset(
+    {"unique", "starts", "inverse8", "exc_val"}
+)
+
+
+def is_packed_dedup(obj) -> bool:
+    """The dedup'd compact-id convention (see module docstring)."""
+    return isinstance(obj, dict) and set(obj) == DEDUP_KEYS
+
+
+def pack_rows_dedup(
+    rows: np.ndarray, unique_pad: int = 0, exc_pad: int = 0
+) -> dict:
+    """Host-side: (B, F) pre-hashed non-negative table rows -> dedup'd
+    struct.  `unique_pad`/`exc_pad` pad the variable-length planes up to
+    fixed sizes (0 = exact); callers wanting shape stability across
+    batches should go through `DedupPacker`."""
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"dedup packing needs (B, F) rows; got {rows.shape}")
+    if rows.size and rows.min() < 0:
+        raise ValueError("dedup packing needs non-negative (hashed) rows")
+    b, f = rows.shape
+    val_dtype = np.uint16 if b <= (1 << 16) else np.uint32
+    uniques, starts = [], np.zeros(f, np.int32)
+    all_ranks = np.empty((b, f), np.int32)
+    total = 0
+    # Rows are HASHED, so their value range is the (small) table capacity
+    # — bincount + a rank LUT ranks a column in O(B + capacity) with no
+    # O(B log B) sort.  This keeps the prefetch-thread pack cost ~1 us
+    # per example; only absurdly sparse id ranges fall back to np.unique.
+    hi = int(rows.max()) + 1 if rows.size else 1
+    use_bincount = hi <= max(4 * rows.size, 1 << 20)
+    lut = np.empty(hi, np.int32) if use_bincount else None
+    for k in range(f):
+        col = rows[:, k]
+        if use_bincount:
+            counts = np.bincount(col, minlength=hi)
+            uniq = np.nonzero(counts)[0]
+            order = np.argsort(-counts[uniq], kind="stable")
+            uniq_ranked = uniq[order]
+            lut[uniq_ranked] = np.arange(len(uniq), dtype=np.int32)
+            all_ranks[:, k] = lut[col]
+        else:
+            uniq, inv, counts = np.unique(
+                col, return_inverse=True, return_counts=True
+            )
+            order = np.argsort(-counts, kind="stable")
+            rank_of = np.empty(len(uniq), np.int32)
+            rank_of[order] = np.arange(len(uniq), dtype=np.int32)
+            all_ranks[:, k] = rank_of[inv]
+            uniq_ranked = uniq[order]
+        uniques.append(uniq_ranked.astype(np.uint32))
+        starts[k] = total
+        total += len(uniq_ranked)
+    cold = all_ranks >= DEDUP_ESCAPE               # (B, F)
+    inverse8 = np.where(cold, DEDUP_ESCAPE, all_ranks).astype(np.uint8)
+    packed = {
+        "unique": np.concatenate(uniques),
+        "starts": starts,
+        "inverse8": inverse8,
+        # boolean indexing scans row-major — the exact order the device
+        # cumsum over (inverse8 == ESCAPE) recovers
+        "exc_val": all_ranks[cold].astype(val_dtype),
+    }
+    if unique_pad or exc_pad:
+        packed = pad_dedup(packed, unique_pad, exc_pad)
+    return packed
+
+
+def pad_dedup(packed: dict, unique_pad: int, exc_pad: int) -> dict:
+    """Pad an exact dedup struct's variable-length planes to fixed sizes
+    (static shapes under jit).  Both pads are inert zeros: padded unique
+    rows are never indexed, and padded exc_val entries sit past the last
+    escape's cumsum index so the device gather only reads them at
+    positions its mask then discards."""
+    unique, exc_val = packed["unique"], packed["exc_val"]
+    out = dict(packed)
+    if unique_pad:
+        if len(unique) > unique_pad:
+            raise ValueError(
+                f"{len(unique)} unique rows exceed unique_pad={unique_pad}"
+            )
+        out["unique"] = np.concatenate(
+            [unique, np.zeros(unique_pad - len(unique), unique.dtype)]
+        )
+    if exc_pad:
+        if len(exc_val) > exc_pad:
+            raise ValueError(
+                f"{len(exc_val)} exceptions exceed exc_pad={exc_pad}"
+            )
+        out["exc_val"] = np.concatenate(
+            [exc_val, np.zeros(exc_pad - len(exc_val), exc_val.dtype)]
+        )
+    return out
+
+
+def unpack_rows_dedup(packed: dict):
+    """Device-side: invert pack_rows_dedup -> (B, F) int32 pre-hashed
+    table rows.  jnp only — call inside the jitted step.  Escape
+    positions carry no explicit indices on the wire: an exclusive prefix
+    count of the escape mask IS each escape's index into exc_val (pack
+    order is the same row-major scan).  One cumsum + two gathers, all
+    tiny next to the embedding gather they feed."""
+    import jax.numpy as jnp
+
+    inv = jnp.asarray(packed["inverse8"]).astype(jnp.int32)   # (B, F)
+    exc_val = jnp.asarray(packed["exc_val"]).astype(jnp.int32)
+    if exc_val.shape[0] == 0:
+        # no escapes possible (an exact pack with every rank < 255)
+        ranks = inv
+    else:
+        mask = (inv == DEDUP_ESCAPE).reshape(-1)
+        # exclusive prefix count: n-th escape (row-major) -> exc_val[n]
+        order = jnp.cumsum(mask) - 1
+        idx = jnp.clip(order, 0, exc_val.shape[0] - 1)
+        patched = jnp.where(mask, exc_val[idx], inv.reshape(-1))
+        ranks = patched.reshape(inv.shape)
+    idx2 = jnp.asarray(packed["starts"]).astype(jnp.int32)[None, :] + ranks
+    return jnp.asarray(packed["unique"]).astype(jnp.int32)[idx2]
+
+
+def dedup_wire_bytes(packed: dict) -> int:
+    """Bytes this struct puts on the host->device link."""
+    return sum(np.asarray(v).nbytes for v in packed.values())
+
+
+def _round_up(n: int, quantum: int) -> int:
+    return max(quantum, ((n + quantum - 1) // quantum) * quantum)
+
+
+class DedupPacker:
+    """pack_rows_dedup with STICKY pad caps: the unique/exception planes
+    are padded to caps that only grow (headroom-scaled, quantum-rounded),
+    so consecutive batches of the same shape produce identical array
+    shapes — jit compiles once, and steps_per_execution's np.stack
+    grouping (which requires equal shapes within a group) holds.  A
+    batch overflowing its cap grows it (one recompile); with the default
+    25% headroom that happens at most a couple of times per run."""
+
+    def __init__(self, quantum: int = 4096, headroom: float = 1.25):
+        self.quantum = int(quantum)
+        self.headroom = float(headroom)
+        self.unique_cap = 0
+        self.exc_cap = 0
+        self.last_unique = 0
+        self.last_exceptions = 0
+
+    def pack(self, rows: np.ndarray) -> dict:
+        exact = pack_rows_dedup(rows)
+        n_unique = int(exact["unique"].shape[0])
+        n_exc = int(exact["exc_val"].shape[0])
+        self.last_unique, self.last_exceptions = n_unique, n_exc
+        if n_unique > self.unique_cap:
+            self.unique_cap = _round_up(
+                int(n_unique * self.headroom), self.quantum
+            )
+        if n_exc > self.exc_cap:
+            self.exc_cap = _round_up(
+                int(n_exc * self.headroom), self.quantum
+            )
+        return pad_dedup(exact, self.unique_cap, self.exc_cap)

@@ -1,0 +1,166 @@
+"""Fused embedding arena: every same-`dim` feature table as ONE array.
+
+Motivation (BENCH r05, docs/PERF.md): a model with F separate
+`DistributedEmbedding` tables issues F gather kernels forward and F
+scatter-add kernels backward per step.  Each kernel pays its own
+dispatch/fusion boundary, and on the row-sharded layout each pays its own
+cross-shard routing.  Stacking all same-dimension tables into one
+row-sharded **arena** — per-feature row ranges, addressed by
+`offset + hash(id) % capacity` — collapses that to ONE gather and ONE
+scatter-add over the concatenated ids, regardless of feature count.
+
+Per-feature capacities survive: feature i owns rows
+[offset_i, offset_i + capacity_i), and its ids are hashed mod its OWN
+capacity before the offset shift, so collision behavior is identical to
+an isolated table of that capacity.  The arena parameter is named
+"embedding" so `embedding_param_sharding` row-shards it over the mesh
+`model` axis exactly like individual tables.
+
+The VJP stays the plain gather/scatter-add pair
+(`embedding.py:_lookup`) per the round-4 re-measurement
+(docs/embedding_design_note.md): the scatter is the ceiling; fancier
+backwards lost.  Note the round-5 finding also stands: do NOT fuse
+tables of DIFFERENT dims into one padded arena — lane padding eats the
+win.  One arena per distinct dim.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.layers.embedding import _lookup, hash_ids, hash_ids_host
+
+
+def arena_offsets(features: Tuple[Tuple[str, int], ...]) -> Dict[str, int]:
+    """{feature name: first arena row} for a (name, capacity) tuple."""
+    offsets, total = {}, 0
+    for name, capacity in features:
+        offsets[name] = total
+        total += int(capacity)
+    return offsets
+
+
+def arena_rows(features: Tuple[Tuple[str, int], ...]) -> int:
+    return sum(int(capacity) for _, capacity in features)
+
+
+class EmbeddingArena(nn.Module):
+    """N per-feature embedding tables fused into one parameter.
+
+    features:   ordered ((name, capacity), ...) — one entry per logical
+                table; order fixes the row layout.
+    output_dim: shared embedding dimension (one arena per dim).
+    hash_input: multiplicative-mix ids before the per-feature mod
+                (same semantics as DistributedEmbedding).
+
+    Call with a dict {name: int ids of any shape (..., )}; returns
+    {name: (..., output_dim)} vectors.  All features' ids are hashed
+    into arena rows, concatenated, and looked up with ONE `_lookup`
+    (one gather forward, one scatter-add backward).
+
+    Call with `prehashed=True` and a single int32 array of arena rows
+    (host-hashed via `arena_rows_host` / the dedup'd wire format) to
+    skip the on-device hashing entirely.
+    """
+
+    features: Tuple[Tuple[str, int], ...]
+    output_dim: int
+    pad_id: int = -1
+    hash_input: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, prehashed: bool = False):
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.05),
+            (arena_rows(self.features), self.output_dim),
+            self.param_dtype,
+        )
+        if prehashed:
+            rows = jnp.asarray(ids)
+            return _lookup(table, rows.reshape(-1)).reshape(
+                rows.shape + (self.output_dim,)
+            )
+        if set(ids) != {name for name, _ in self.features}:
+            raise ValueError(
+                f"arena expects ids for {[n for n, _ in self.features]}, "
+                f"got {sorted(ids)}"
+            )
+        # Per-feature hashed rows, flattened per example and concatenated:
+        # the single gather's id stream.  Pure index arithmetic — XLA
+        # fuses it into the gather; no extra kernels.
+        batch = None
+        parts, valids, shapes = [], [], []
+        offset = 0
+        for name, capacity in self.features:
+            x = jnp.asarray(ids[name])
+            if batch is None:
+                batch = x.shape[0]
+            valid = x != self.pad_id
+            rows = hash_ids(
+                jnp.where(valid, x, 0), capacity, mix=self.hash_input
+            ) + jnp.int32(offset)
+            parts.append(rows.reshape(batch, -1))
+            valids.append(valid.reshape(batch, -1))
+            shapes.append(x.shape)
+            offset += int(capacity)
+        all_rows = jnp.concatenate(parts, axis=1)          # (B, sum k_i)
+        all_valid = jnp.concatenate(valids, axis=1)
+        vecs = _lookup(table, all_rows.reshape(-1)).reshape(
+            all_rows.shape + (self.output_dim,)
+        )
+        vecs = jnp.where(all_valid[..., None], vecs, 0.0)
+        out, col = {}, 0
+        for (name, _), shape in zip(self.features, shapes):
+            k = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 \
+                else 1
+            out[name] = vecs[:, col: col + k].reshape(
+                shape + (self.output_dim,)
+            )
+            col += k
+        return out
+
+    # ---- host-side helpers (packers / equivalence tests) ---------------
+
+    def arena_rows_host(self, ids: Dict[str, "np.ndarray"]) -> np.ndarray:
+        """numpy replica of the device row computation: {name: (B, k)}
+        raw ids -> (B, sum k) int32 arena rows, bit-exact vs the traced
+        path.  Used by host packers (dedup'd wire format) so the device
+        consumes rows directly (`prehashed=True`)."""
+        parts, offset = [], 0
+        for name, capacity in self.features:
+            x = np.asarray(ids[name])
+            if np.any(x == self.pad_id):
+                raise ValueError(
+                    f"arena_rows_host: feature {name!r} contains pad ids "
+                    f"({self.pad_id}); the prehashed fast path cannot "
+                    "represent masked positions — use the per-feature path"
+                )
+            rows = hash_ids_host(x, capacity, mix=self.hash_input) + offset
+            parts.append(rows.reshape(x.shape[0], -1).astype(np.int32))
+            offset += int(capacity)
+        return np.concatenate(parts, axis=1)
+
+
+def arena_table_from_feature_tables(
+    features: Tuple[Tuple[str, int], ...], tables: Dict[str, jnp.ndarray]
+) -> jnp.ndarray:
+    """Stack per-feature tables (e.g. from trained DistributedEmbedding
+    params) into the arena parameter, preserving row layout — the bridge
+    for proving arena/per-feature numerical identity and for migrating
+    checkpoints of per-table models."""
+    parts = []
+    for name, capacity in features:
+        t = jnp.asarray(tables[name])
+        if t.shape[0] != capacity:
+            raise ValueError(
+                f"table {name!r} has {t.shape[0]} rows, arena slot has "
+                f"{capacity}"
+            )
+        parts.append(t)
+    return jnp.concatenate(parts, axis=0)

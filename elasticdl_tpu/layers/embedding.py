@@ -83,6 +83,21 @@ def hash_ids(ids: jnp.ndarray, capacity: int, mix: bool = True) -> jnp.ndarray:
     return (ids % jnp.uint32(capacity)).astype(jnp.int32)
 
 
+def hash_ids_host(ids, capacity: int, mix: bool = True):
+    """Bit-exact numpy replica of `hash_ids` for HOST-side packers (the
+    dedup'd wire format hashes in the prefetch thread so the device can
+    skip the hash and consume table rows directly).  uint32 wraparound
+    arithmetic matches the device path including negative-id
+    reinterpretation."""
+    import numpy as np
+
+    ids = np.asarray(ids).astype(np.uint32)
+    if mix:
+        with np.errstate(over="ignore"):
+            ids = ids * np.uint32(_MIX)
+    return (ids % np.uint32(capacity)).astype(np.int32)
+
+
 class DistributedEmbedding(nn.Module):
     """Drop-in equivalent of the reference's `elasticdl.Embedding`.
 
@@ -105,7 +120,7 @@ class DistributedEmbedding(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, ids):
+    def __call__(self, ids, prehashed: bool = False):
         table = self.param(
             "embedding",
             nn.initializers.normal(stddev=0.05),
@@ -113,6 +128,18 @@ class DistributedEmbedding(nn.Module):
             self.param_dtype,
         )
         ids = jnp.asarray(ids)
+        if prehashed:
+            # ids are already table rows in [0, input_dim) — computed on
+            # the HOST by the dedup'd wire format (hash_ids_host) so the
+            # device skips the hash/mod.  Pad masking does not apply:
+            # the packer asserts the stream carries no pad ids.
+            vecs = _lookup(table, ids.reshape(-1)).reshape(
+                ids.shape + (self.output_dim,)
+            )
+            if self.combiner is None:
+                return vecs
+            valid = jnp.ones(ids.shape, bool)
+            return self._combine(vecs, valid)
         valid = ids != self.pad_id
         rows = hash_ids(jnp.where(valid, ids, 0), self.input_dim,
                         mix=self.hash_input)
@@ -122,6 +149,9 @@ class DistributedEmbedding(nn.Module):
         vecs = jnp.where(valid[..., None], vecs, 0.0)
         if self.combiner is None:
             return vecs
+        return self._combine(vecs, valid)
+
+    def _combine(self, vecs, valid):
         count = jnp.maximum(
             jnp.sum(valid, axis=-1, keepdims=True).astype(vecs.dtype), 1.0
         )

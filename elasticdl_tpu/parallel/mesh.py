@@ -138,9 +138,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
-    """Place a host batch onto the mesh split along the data axis."""
+    """Place a host batch onto the mesh split along the data axis.
+
+    The dedup'd id plane (data/wire.py) is the one structured leaf: only
+    its `inverse8` plane is batch-major; the unique/starts/exc_val side
+    planes are whole-batch tables every shard reads, so they replicate
+    (splitting them over `data` would be wrong — and (F,) `starts` does
+    not even divide the axis)."""
+    from elasticdl_tpu.data.wire import is_packed_dedup
+
     sharding = data_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    repl = replicated(mesh)
+
+    def put(x):
+        if is_packed_dedup(x):
+            return {
+                k: jax.device_put(v, sharding if k == "inverse8" else repl)
+                for k, v in x.items()
+            }
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(put, batch, is_leaf=is_packed_dedup)
 
 
 def make_global_batch(batch: Dict[str, np.ndarray], mesh: Mesh):

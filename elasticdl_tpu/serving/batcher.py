@@ -309,6 +309,28 @@ class DynamicBatcher:
         return batch
 
     def _execute(self, batch) -> None:
+        # Packed-payload clients (engine.packed_feature_spec ships id
+        # planes as uint24 triples) may share the queue with native
+        # ones; differently-shaped arrays can't concatenate, so run one
+        # engine call per run of same-form items (arrival order kept).
+        def form(item):
+            return tuple(
+                (k, np.asarray(item.features[k]).dtype.str,
+                 np.asarray(item.features[k]).ndim)
+                for k in sorted(item.features)
+            )
+
+        groups = []
+        for item in batch:
+            f = form(item)
+            if groups and groups[-1][0] == f:
+                groups[-1][1].append(item)
+            else:
+                groups.append((f, [item]))
+        for _, group in groups:
+            self._execute_uniform(group)
+
+    def _execute_uniform(self, batch) -> None:
         rows = sum(item.rows for item in batch)
         features = {
             k: np.concatenate(

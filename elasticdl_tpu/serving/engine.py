@@ -59,6 +59,30 @@ def _zeros_features(feature_spec: Dict[str, dict], rows: int) -> dict:
     }
 
 
+def packed_leaf_spec(leaf: dict) -> Optional[dict]:
+    """The uint24-packed wire variant of an integer id feature leaf, or
+    None when the leaf has no packed form.  An int32/int64 feature of
+    per-row shape (F,) may instead arrive as (F, 3) uint8 little-endian
+    triples (data/wire.py pack_int_to_uint24) — 3 bytes/id on the
+    request payload instead of 4.  Zoo models on the CTR record format
+    auto-unpack inside the jitted forward (deepfm sparse_ids), so the
+    engine only needs to ACCEPT the shape; it never converts."""
+    if np.dtype(leaf["dtype"]) not in (np.dtype(np.int32),
+                                       np.dtype(np.int64)):
+        return None
+    return {"shape": [*leaf["shape"], 3], "dtype": "uint8"}
+
+
+def packed_feature_spec(feature_spec: Dict[str, dict]) -> Dict[str, dict]:
+    """The signature a bandwidth-conscious Predict client should send:
+    every integer id feature in its uint24-packed form, everything else
+    native.  Serialize ids with data/wire.py `pack_int_to_uint24`."""
+    return {
+        name: packed_leaf_spec(leaf) or dict(leaf)
+        for name, leaf in feature_spec.items()
+    }
+
+
 class ServingEngine:
     """Executes a model's forward pass over precompiled batch buckets.
 
@@ -245,7 +269,10 @@ class ServingEngine:
 
     def validate(self, features: Dict[str, np.ndarray]) -> Optional[str]:
         """None when `features` matches the serving signature, else a
-        client-facing error string (SERVING_INVALID)."""
+        client-facing error string (SERVING_INVALID).  Integer id
+        features are accepted in EITHER the native form or the
+        uint24-packed wire form (`packed_feature_spec`) — per feature,
+        so a client may pack only its large id planes."""
         if not isinstance(features, dict):
             return "features must be a dict of named arrays"
         if set(features) != set(self._feature_spec):
@@ -256,17 +283,28 @@ class ServingEngine:
         rows = None
         for name, leaf in self._feature_spec.items():
             arr = np.asarray(features[name])
-            want_dtype = np.dtype(leaf["dtype"])
-            if arr.dtype != want_dtype:
+            packed = packed_leaf_spec(leaf)
+
+            def matches(spec):
                 return (
-                    f"feature '{name}' has dtype {arr.dtype}, expected "
-                    f"{want_dtype}"
+                    arr.dtype == np.dtype(spec["dtype"])
+                    and arr.ndim == 1 + len(spec["shape"])
+                    and list(arr.shape[1:]) == list(spec["shape"])
                 )
-            if arr.ndim != 1 + len(leaf["shape"]) \
-                    or list(arr.shape[1:]) != list(leaf["shape"]):
+
+            if not matches(leaf) and not (packed and matches(packed)):
+                accepted = (
+                    f"(rows, {', '.join(map(str, leaf['shape']))}) "
+                    f"{leaf['dtype']}"
+                )
+                if packed:
+                    accepted += (
+                        f" or uint24-packed (rows, "
+                        f"{', '.join(map(str, packed['shape']))}) uint8"
+                    )
                 return (
-                    f"feature '{name}' has shape {arr.shape}, expected "
-                    f"(rows, {', '.join(map(str, leaf['shape']))})"
+                    f"feature '{name}' has shape {arr.shape} dtype "
+                    f"{arr.dtype}, expected {accepted}"
                 )
             if rows is None:
                 rows = arr.shape[0]

@@ -209,6 +209,7 @@ def _main(argv=None):
             wedge_grace_s=args.wedge_grace_s,
             steps_per_execution=getattr(args, "steps_per_execution", 1),
             compact_wire=getattr(args, "compact_wire", False),
+            wire_format=getattr(args, "wire_format", ""),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")
@@ -229,6 +230,7 @@ def _main(argv=None):
             checkpoint_steps=args.checkpoint_steps,
             steps_per_execution=getattr(args, "steps_per_execution", 1),
             compact_wire=getattr(args, "compact_wire", False),
+            wire_format=getattr(args, "wire_format", ""),
             tensorboard_dir=tb_dir,
             profile_dir=(
                 os.path.join(args.profile_dir, f"worker-{worker_id}")

@@ -39,7 +39,7 @@ import numpy as np
 from elasticdl_tpu.common import resilience
 from elasticdl_tpu.common.jax_compat import distributed_is_initialized
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.model_handler import ModelSpec
+from elasticdl_tpu.common.model_handler import ModelSpec, resolve_wire_format
 from elasticdl_tpu.parallel import mesh as mesh_lib
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.worker.task_data_service import TaskDataService
@@ -113,6 +113,11 @@ def wait_for_confirmed_epoch(
 
 
 class SPMDWorker:
+    # class-level defaults (same rationale as Worker: bare __new__
+    # construction in tests)
+    wire_format = "plain"
+    compact_wire = False
+
     """One rank of a multi-process SPMD training job."""
 
     def __init__(
@@ -138,6 +143,7 @@ class SPMDWorker:
         profile_dir: str = "",
         steps_per_execution: int = 1,
         compact_wire: bool = False,
+        wire_format: str = "",
         rpc_policy: Optional[resilience.RetryPolicy] = None,
     ):
         self.worker_id = worker_id
@@ -149,16 +155,22 @@ class SPMDWorker:
         )
         self.spec = spec
         self.minibatch_size = minibatch_size
-        # --compact_wire (same contract as Worker): parse straight into
-        # the zoo's compact device wire format when it provides one
-        self.compact_wire = bool(
-            compact_wire and spec.feed_bulk_compact is not None
-        )
-        if compact_wire and spec.feed_bulk_compact is None:
+        # --wire_format / --compact_wire (same contract as Worker), with
+        # one SPMD restriction: the dedup format's padded shapes are
+        # governed by each rank's OWN sticky packer caps, which can grow
+        # at different steps on different ranks — a collective program
+        # shape mismatch.  Degrade dedup to the compact format here.
+        if (wire_format or "").strip().lower() == "dedup":
             logger.warning(
-                "--compact_wire requested but the zoo module defines no "
-                "feed_bulk_compact; using the standard feed"
+                "--wire_format=dedup is not supported under SPMD "
+                "slice-local reads (per-rank dedup caps diverge); "
+                "using the compact wire format instead"
             )
+            wire_format = "compact"
+        self.wire_format = resolve_wire_format(
+            spec, wire_format, compact_wire, logger
+        )
+        self.compact_wire = self.wire_format == "compact"
         # >1 dispatches that many collective train steps as one jitted
         # scan over a global (K, B, ...) batch stack (deterministic
         # grouping — identical on every rank)
@@ -570,13 +582,16 @@ class SPMDWorker:
                 )
                 self._recovery_t0 = None
 
-        def single_step(one_batch, one_is_local):
+        def make_gb(one_batch, one_is_local):
             if one_is_local:
-                gb = mesh_lib.make_global_batch_from_local(
+                return mesh_lib.make_global_batch_from_local(
                     one_batch, self.mesh, self.minibatch_size, local[0]
                 )
-            else:
-                gb = mesh_lib.make_global_batch(one_batch, self.mesh)
+            return mesh_lib.make_global_batch(one_batch, self.mesh)
+
+        def single_step(one_batch, one_is_local, gb=None):
+            if gb is None:
+                gb = make_gb(one_batch, one_is_local)
             self.state, loss = self.trainer.train_on_global_batch(
                 self.state, gb
             )
@@ -594,8 +609,30 @@ class SPMDWorker:
         # recovery clock measures loss -> FIRST optimizer step, not
         # loss -> K steps.
         pending = []
+        # Second buffering level (single-step dispatch only): the global
+        # batch for step k+1 is assembled — shard transfers issued — on
+        # the consumer thread while step k's collective executes.  The
+        # host batch rides along untouched: _ensure_state and the
+        # steps_per_execution grouping path want host arrays.
+        device_stage = None
+        if self.steps_per_execution == 1:
+            def device_stage(item):
+                staged_batch, staged_real, staged_is_local = item
+                if self.state is None:
+                    # init_state_global (first loop iteration) must be
+                    # the mesh's FIRST collective program; assembling
+                    # global arrays ahead of it breaks the multi-process
+                    # CPU backend used in tests.  Nothing to overlap
+                    # before step 1 anyway.
+                    return item
+                return (
+                    staged_batch, staged_real, staged_is_local,
+                    make_gb(staged_batch, staged_is_local),
+                )
         # host read/parse overlaps the collective step (double buffering)
-        for batch, real, is_local in prefetch_batches(batches):
+        for item in prefetch_batches(batches, device_stage=device_stage):
+            batch, real, is_local = item[:3]
+            gb = item[3] if len(item) > 3 else None
             self._ensure_state(batch, global_rows=self.minibatch_size)
             records += real
             if (
@@ -627,7 +664,7 @@ class SPMDWorker:
             for held in pending:
                 single_step(held, True)
             pending = []
-            single_step(batch, is_local)
+            single_step(batch, is_local, gb=gb)
         for batch in pending:  # task tail: single-step program
             single_step(batch, True)
         if self.last_loss is not None:
@@ -918,11 +955,12 @@ class SPMDWorker:
     @property
     def _feed_bulk(self):
         """Vectorized-parse closure (same contract as Worker._feed_bulk)."""
-        fn = (
-            self.spec.feed_bulk_compact
-            if self.compact_wire
-            else self.spec.feed_bulk
-        )
+        if self.wire_format == "dedup":  # unreachable today; see __init__
+            fn = self.spec.feed_bulk_dedup
+        elif self.compact_wire:
+            fn = self.spec.feed_bulk_compact
+        else:
+            fn = self.spec.feed_bulk
         if fn is None:
             return None
         metadata = getattr(self._reader, "metadata", {})

@@ -127,6 +127,17 @@ class ModelOwner:
             self._maybe_checkpoint(stride=len(batches))
             return losses
 
+    def stage_batch(self, batch):
+        """Start batch's host->device transfer (Trainer.stage_batch) and
+        return the placed batch for a later train_batch call — the
+        double-buffering hook prefetch_batches' device_stage calls.
+        ensure_state runs FIRST, on the host batch: its export-signature
+        snapshot and init want host arrays, and init_state must precede
+        any same-shaped device work anyway."""
+        with self.lock:
+            self.ensure_state(batch)
+            return self.trainer.stage_batch(batch)
+
     def predict_batch(self, batch, state=None):
         """Forward pass; `state` overrides the owner's current state (eval
         at a restored version)."""

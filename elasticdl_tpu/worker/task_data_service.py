@@ -39,15 +39,28 @@ def _retryable(exc: BaseException) -> bool:
     return _is_rpc_error(exc) or isinstance(exc, InjectedFault)
 
 
-def prefetch_batches(iterator, depth: int = 2):
+def prefetch_batches(iterator, depth: int = 2, device_stage=None,
+                     device_depth: int = 1):
     """Run a host-side batch iterator (reader IO + feed parsing) in a
     background thread, keeping up to `depth` batches ready while the
     caller's thread drives the device — read/parse overlaps compute (the
     double-buffering every input pipeline wants; measured in bench.py's
     e2e mode).  Pure host work only: the producer never touches device
-    APIs, so it is safe on every backend including the virtual CPU mesh.
+    APIs, so it is safe on every backend including the virtual CPU mesh
+    (scripts/check_host_device_boundary.py enforces this).
 
-    Exceptions from the iterator re-raise at the consumer; abandoning the
+    `device_stage`, when given, adds a second buffering level for the
+    host->device TRANSFER: up to `device_depth` upcoming batches are
+    passed through `device_stage(item)` on the CONSUMER thread before
+    the current batch's result is yielded, so batch k+1's device_put
+    overlaps the caller's execution of batch k (JAX transfers are async
+    — device_put returns as soon as the copy is enqueued).  Staging on
+    the consumer thread honors the trainer's single-device-thread
+    constraint: only ONE thread ever touches device APIs.
+
+    Exceptions from the iterator re-raise at the consumer; a
+    device_stage exception also re-raises at the consumer (in yield
+    order, never ahead of earlier un-yielded batches).  Abandoning the
     generator (break / task failure) unblocks and stops the producer."""
     import queue
     import threading
@@ -80,7 +93,8 @@ def prefetch_batches(iterator, depth: int = 2):
 
     thread = threading.Thread(target=produce, daemon=True)
     thread.start()
-    try:
+
+    def consume():
         while True:
             item = q.get()
             if item is sentinel:
@@ -88,6 +102,36 @@ def prefetch_batches(iterator, depth: int = 2):
                     raise error[0]
                 return
             yield item
+
+    try:
+        if device_stage is None:
+            yield from consume()
+            return
+        from collections import deque
+
+        staged: "deque" = deque()
+        source = consume()
+        while True:
+            try:
+                item = next(source)
+            except StopIteration:
+                break
+            except BaseException:
+                # reader died: batches already staged are good transfers
+                # — deliver them before surfacing the failure
+                while staged:
+                    yield staged.popleft()
+                raise
+            try:
+                staged.append(device_stage(item))
+            except BaseException:
+                while staged:
+                    yield staged.popleft()
+                raise
+            if len(staged) > device_depth:
+                yield staged.popleft()
+        while staged:
+            yield staged.popleft()
     finally:
         stop.set()
 

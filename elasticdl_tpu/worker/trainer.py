@@ -313,6 +313,24 @@ class Trainer:
 
     # ---- host-side helpers --------------------------------------------
 
+    def stage_batch(self, batch: Dict[str, np.ndarray]):
+        """Start `batch`'s host->device transfer NOW; return the placed
+        batch (an overlap handle) for a later train_on_batch call.
+
+        Double buffering's second half: device_put is asynchronous on
+        real backends, so staging batch k+1 while batch k executes hides
+        the transfer behind compute.  train_on_batch re-shards the
+        staged result, which is a no-op for an array already placed with
+        the same sharding — staged and unstaged batches flow through the
+        same path.  Must be called from the ONE thread that drives the
+        device (prefetch_batches stages on the consumer thread): on the
+        CPU backend the transfer rides inside the serialized region
+        (_CPU_EXEC_LOCK), on TPU it's a plain async enqueue."""
+        mesh_lib.set_current_mesh(self.mesh)
+        return run_device_serialized(
+            mesh_lib.shard_batch, batch, self.mesh
+        )
+
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
         mesh_lib.set_current_mesh(self.mesh)  # for mesh-aware model code
 
@@ -331,14 +349,27 @@ class Trainer:
         lax.scan).  Returns (state, losses) with losses shaped (K,).
         Batches must share shapes (the data service's static-shape
         contract guarantees it)."""
+        from elasticdl_tpu.data.wire import is_packed_dedup
+
         mesh_lib.set_current_mesh(self.mesh)
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
         sharding = mesh_lib.stacked_data_sharding(self.mesh)
+        repl = mesh_lib.replicated(self.mesh)
+
+        def put(x):
+            if is_packed_dedup(x):
+                # only inverse8 is batch-major under the (K, ...) stack;
+                # the side planes replicate (see mesh.shard_batch)
+                return {
+                    k: jax.device_put(
+                        v, sharding if k == "inverse8" else repl
+                    )
+                    for k, v in x.items()
+                }
+            return jax.device_put(x, sharding)
 
         def _step():
-            placed = jax.tree.map(
-                lambda x: jax.device_put(x, sharding), stacked
-            )
+            placed = jax.tree.map(put, stacked, is_leaf=is_packed_dedup)
             return self.train_step_many(state, placed)
 
         return run_device_serialized(_step)
@@ -365,12 +396,25 @@ class Trainer:
         return run_device_serialized(self.eval_step, state, global_features)
 
     def predict_on_batch(self, state, features):
+        from elasticdl_tpu.data.wire import is_packed_dedup
+
         mesh_lib.set_current_mesh(self.mesh)
+        repl = mesh_lib.replicated(self.mesh)
+
+        def put(x):
+            if is_packed_dedup(x):
+                # same placement rule as mesh.shard_batch: only inverse8
+                # is batch-major; the side planes replicate
+                return {
+                    k: jax.device_put(
+                        v, self._data if k == "inverse8" else repl
+                    )
+                    for k, v in x.items()
+                }
+            return jax.device_put(x, self._data)
 
         def _step():
-            placed = jax.tree.map(
-                lambda x: jax.device_put(x, self._data), features
-            )
+            placed = jax.tree.map(put, features, is_leaf=is_packed_dedup)
             return np.asarray(self.eval_step(state, placed))
 
         return run_device_serialized(_step)

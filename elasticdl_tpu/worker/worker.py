@@ -20,13 +20,28 @@ from typing import Dict, Optional
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.model_handler import ModelSpec
+from elasticdl_tpu.common.model_handler import ModelSpec, resolve_wire_format
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.worker.sync import ModelOwner
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import Trainer, run_device_serialized
 
 logger = get_logger(__name__)
+
+
+def _same_batch_shapes(a, b) -> bool:
+    """True when two host batches have identical leaf shapes/dtypes —
+    the np.stack compatibility the K-step scan program requires.  Only
+    the dedup wire format ever produces ragged consecutive batches
+    (sticky pad-cap growth, data/wire.py DedupPacker)."""
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.shape(x) == np.shape(y)
+        and getattr(x, "dtype", None) == getattr(y, "dtype", None)
+        for x, y in zip(la, lb)
+    )
 
 
 def invoke_callbacks(callbacks, hook: str, *args) -> None:
@@ -92,6 +107,11 @@ class TransientTaskError(RuntimeError):
 
 
 class Worker:
+    # class-level defaults: tests (and recovery paths) build bare
+    # instances via __new__ and set only what they exercise
+    wire_format = "plain"
+    compact_wire = False
+
     def __init__(
         self,
         worker_id: int,
@@ -110,21 +130,19 @@ class Worker:
         profile_dir: str = "",
         steps_per_execution: int = 1,
         compact_wire: bool = False,
+        wire_format: str = "",
     ):
         self.worker_id = worker_id
         self.spec = spec
         self.minibatch_size = minibatch_size
-        # --compact_wire: ship batches in the zoo's compact device wire
-        # format when it provides one (fewer H2D bytes/example); the
-        # zoo's model accepts the compact dtypes by contract
-        self.compact_wire = bool(
-            compact_wire and spec.feed_bulk_compact is not None
+        # --wire_format / --compact_wire: ship batches in a reduced device
+        # wire format when the zoo provides one (fewer H2D bytes/example);
+        # the zoo's model accepts the reduced dtypes by contract.  An
+        # unavailable format degrades to the next-best the zoo defines.
+        self.wire_format = resolve_wire_format(
+            spec, wire_format, compact_wire, logger
         )
-        if compact_wire and spec.feed_bulk_compact is None:
-            logger.warning(
-                "--compact_wire requested but the zoo module defines no "
-                "feed_bulk_compact; using the standard feed"
-            )
+        self.compact_wire = self.wire_format == "compact"
         # >1 dispatches that many train steps as ONE jitted lax.scan
         # program (Trainer.train_on_batch_stack) — amortizes per-dispatch
         # overhead, which dominates on remote/tunneled TPU runtimes.
@@ -321,12 +339,22 @@ class Worker:
         records = 0
         loss = None
         pending = []
+        # Second buffering level (single-step dispatch only): batch k+1's
+        # host->device transfer is issued while batch k executes
+        # (ModelOwner.stage_batch; device_put is async on real backends).
+        # The stacked path keeps host batches — np.stack wants numpy.
+        device_stage = None
+        if self.steps_per_execution == 1:
+            def device_stage(item):
+                staged_batch, staged_real = item
+                return self._owner.stage_batch(staged_batch), staged_real
         # host read/parse overlaps the device step (double buffering)
         for batch, real in prefetch_batches(
             self._data_service.batches_for_task(
                 task, self.minibatch_size, self._feed,
                 feed_bulk=self._feed_bulk,
-            )
+            ),
+            device_stage=device_stage,
         ):
             records += real
             if self.steps_per_execution > 1:
@@ -334,6 +362,15 @@ class Worker:
                 # tail (< steps_per_execution batches) falls through to
                 # the single-step program below, so only the two K values
                 # {1, steps_per_execution} are ever compiled
+                if pending and not _same_batch_shapes(pending[-1], batch):
+                    # dedup sticky caps can grow between batches; a
+                    # mixed-shape group can't np.stack — drain the held
+                    # batches through the single-step program first
+                    for held in pending:
+                        loss = self._owner.train_batch(held)
+                        self.step_timer.tick()
+                        self.losses.append(loss)
+                    pending.clear()
                 pending.append(batch)
                 if len(pending) == self.steps_per_execution:
                     losses = self._owner.train_batch_stack(pending)
@@ -478,13 +515,14 @@ class Worker:
     def _feed_bulk(self):
         """Vectorized-parse closure for batches_for_task, or None when the
         zoo module has no feed_bulk (the streaming feed path then runs).
-        With --compact_wire and a zoo feed_bulk_compact, batches parse
-        straight into the compact device wire format."""
-        fn = (
-            self.spec.feed_bulk_compact
-            if self.compact_wire
-            else self.spec.feed_bulk
-        )
+        With --wire_format (or legacy --compact_wire) and the matching
+        zoo feed, batches parse straight into that device wire format."""
+        if self.wire_format == "dedup":
+            fn = self.spec.feed_bulk_dedup
+        elif self.compact_wire:
+            fn = self.spec.feed_bulk_compact
+        else:
+            fn = self.spec.feed_bulk
         if fn is None:
             return None
         metadata = getattr(self._reader, "metadata", {})

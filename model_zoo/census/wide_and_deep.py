@@ -2,10 +2,16 @@
 
 Zoo-contract port of the reference's census wide&deep model (SURVEY.md C20,
 the SQLFlow-generated variant) re-designed for TPU: categorical features go
-through mesh-sharded DistributedEmbedding tables; the wide half uses hashed
-cross features with dim-1 embeddings (the classic wide&deep recipe); the
-deep half is an MLP on the MXU.  Records come from the CSV reader (rows of
-strings), exercising the tabular data path.
+through mesh-sharded embedding ARENAS (layers/arena.py) — all same-dim
+feature tables fused into one row-sharded parameter, so the deep half's 8
+categorical features cost ONE gather/scatter-add pair and the wide half's
+10 (8 raw + 2 crossed) another, with each feature owning its own row range
+(per-feature capacity, no cross-feature collisions).  The wide half uses
+hashed cross features with dim-1 embeddings (the classic wide&deep
+recipe); the deep half is an MLP on the MXU.  The two arenas stay separate
+per the round-5 finding: fusing different dims pads lanes and loses.
+Records come from the CSV reader (rows of strings), exercising the tabular
+data path.
 """
 
 from __future__ import annotations
@@ -15,10 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from elasticdl_tpu.layers.embedding import (
-    DistributedEmbedding,
-    embedding_param_sharding,
-)
+from elasticdl_tpu.layers.arena import EmbeddingArena
+from elasticdl_tpu.layers.embedding import embedding_param_sharding
 from model_zoo.common.metrics import auc, binary_accuracy
 
 NUMERIC_COLS = ["age", "capital_gain", "capital_loss", "hours_per_week"]
@@ -35,6 +39,24 @@ _CROSSES = [("education", "occupation"), ("marital_status", "relationship")]
 from elasticdl_tpu.preprocessing.layers import fnv1a_hash as _string_hash
 
 
+_WIDE_COLS = CATEGORICAL_COLS + [f"{a}_x_{b}" for a, b in _CROSSES]
+
+
+def deep_arena_features(vocab_capacity: int):
+    """((name, capacity), ...) for the deep arena: the 8 categorical
+    columns split the deep vocab budget evenly, so the arena parameter
+    keeps the exact (vocab_capacity, embed_dim) shape the shared-table
+    model had — checkpoints stay row-count compatible."""
+    per = max(vocab_capacity // len(CATEGORICAL_COLS), 1)
+    return tuple((name, per) for name in CATEGORICAL_COLS)
+
+
+def wide_arena_features(vocab_capacity: int):
+    """((name, capacity), ...) for the wide arena (8 raw + 2 crossed)."""
+    per = max(vocab_capacity // len(CATEGORICAL_COLS), 1)
+    return tuple((name, per) for name in _WIDE_COLS)
+
+
 class WideAndDeep(nn.Module):
     vocab_capacity: int = 4096
     embed_dim: int = 8
@@ -48,20 +70,29 @@ class WideAndDeep(nn.Module):
 
         numeric = jnp.log1p(jnp.abs(numeric))
 
-        # deep half: embeddings + numerics -> MLP
-        emb = DistributedEmbedding(
-            self.vocab_capacity, self.embed_dim, name="deep_embedding"
-        )(cat)                                              # (B, 8, k)
+        # deep half: ONE fused gather over all 8 categorical features
+        # (per-feature row ranges inside one arena parameter)
+        deep_vecs = EmbeddingArena(
+            deep_arena_features(self.vocab_capacity), self.embed_dim,
+            name="deep_embedding",
+        )({name: cat[:, j] for j, name in enumerate(CATEGORICAL_COLS)})
+        emb = jnp.stack(
+            [deep_vecs[name] for name in CATEGORICAL_COLS], axis=1
+        )                                                   # (B, 8, k)
         h = jnp.concatenate([numeric, emb.reshape(emb.shape[0], -1)], -1)
         for i, width in enumerate(self.mlp_dims):
             h = nn.relu(nn.Dense(width, name=f"mlp_{i}")(h))
         deep = nn.Dense(1, name="deep_out")(h)[..., 0]
 
-        # wide half: dim-1 embeddings over raw + crossed categoricals
+        # wide half: a second dim-1 arena over raw + crossed categoricals
+        # (separate from the deep arena — different dim, round-5 rule);
+        # its 10 scalar weights sum into the linear term.
         wide_ids = jnp.concatenate([cat, cross], axis=1)    # (B, 10)
-        wide = DistributedEmbedding(
-            self.vocab_capacity, 1, combiner="sum", name="wide_linear"
-        )(wide_ids)[..., 0]
+        wide_vecs = EmbeddingArena(
+            wide_arena_features(self.vocab_capacity), 1,
+            name="wide_linear",
+        )({name: wide_ids[:, j] for j, name in enumerate(_WIDE_COLS)})
+        wide = sum(wide_vecs[name][..., 0] for name in _WIDE_COLS)
         wide = wide + nn.Dense(1, name="wide_numeric")(numeric)[..., 0]
 
         return wide + deep  # logits

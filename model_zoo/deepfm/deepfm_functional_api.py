@@ -62,6 +62,44 @@ def sparse_ids(features) -> jnp.ndarray:
     return sparse
 
 
+def sparse_field_rows(features, vocab_capacity: int):
+    """(B, 26) rows into the shared table, plus whether they are already
+    hashed.  The dedup'd wire format (feed_bulk_dedup) ships PRE-HASHED
+    rows — the device reconstructs them with a scatter-patch + gather
+    (wire.unpack_rows_dedup) and the embeddings skip their own hash/mod
+    (`prehashed=True`).  Every other format goes through the usual
+    field-offset + on-device hash path."""
+    sparse = features["sparse"]
+    from elasticdl_tpu.data.wire import is_packed_dedup, unpack_rows_dedup
+
+    if is_packed_dedup(sparse):
+        return unpack_rows_dedup(sparse), True
+    return field_offset_ids(sparse_ids(features)), False
+
+
+def hash_field_rows_host(sparse, vocab_capacity: int):
+    """Host-side numpy replica of `field_offset_ids` + the embeddings'
+    `hash_ids(..., mix=True)` — bit-exact vs the traced path (uint32
+    wraparound everywhere).  Raises if any post-offset id equals the
+    pad sentinel (-1): the device path would zero-mask that position and
+    the prehashed fast path cannot represent it (probability ~26/2^32
+    per example on real streams)."""
+    from elasticdl_tpu.layers.embedding import hash_ids_host
+
+    sparse = np.asarray(sparse)
+    offsets = (
+        np.arange(NUM_SPARSE, dtype=np.uint32) * np.uint32(0x61C88647)
+    )
+    with np.errstate(over="ignore"):
+        field_ids = sparse.astype(np.uint32) + offsets[None, :]
+    if np.any(field_ids == np.uint32(0xFFFFFFFF)):
+        raise ValueError(
+            "dedup packing: a field-offset id equals the pad sentinel "
+            "(-1); this batch must ship on the non-dedup wire format"
+        )
+    return hash_ids_host(field_ids, vocab_capacity, mix=True)
+
+
 def normalize_dense(dense: jnp.ndarray) -> jnp.ndarray:
     """Signed log1p squashing of the 13 dense counters (Criteo-style
     heavy-tailed counts)."""
@@ -81,17 +119,21 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features):
-        field_ids = field_offset_ids(sparse_ids(features))  # (B, 26)
+        # (B, 26) rows; prehashed=True on the dedup'd wire format (the
+        # host already hashed — both tables then skip their hash/mod)
+        field_ids, prehashed = sparse_field_rows(
+            features, self.vocab_capacity
+        )
 
         # second-order / deep embeddings: (B, 26, k)
         emb = DistributedEmbedding(
             self.vocab_capacity, self.embed_dim, hash_input=True,
             name="fm_embedding",
-        )(field_ids)
+        )(field_ids, prehashed=prehashed)
         # first-order weights: (B, 26, 1)
         first = DistributedEmbedding(
             self.vocab_capacity, 1, hash_input=True, name="fm_linear",
-        )(field_ids)
+        )(field_ids, prehashed=prehashed)
 
         # FM second order: 0.5 * sum_k [ (sum_f v)^2 - sum_f v^2 ]
         sum_f = jnp.sum(emb, axis=1)
@@ -120,6 +162,10 @@ class DeepFM(nn.Module):
 def custom_model(
     vocab_capacity: int = 1 << 18, embed_dim: int = 16, bf16: bool = False
 ):
+    global DEDUP_VOCAB_CAPACITY
+    # the dedup feed hashes on the HOST, so it must use the capacity the
+    # model in this process was built with (feeds get no model handle)
+    DEDUP_VOCAB_CAPACITY = int(vocab_capacity)
     return DeepFM(
         vocab_capacity=vocab_capacity,
         embed_dim=embed_dim,
@@ -198,6 +244,37 @@ def feed_bulk_compact(buffer, sizes, metadata=None):
         "features": {
             "dense": pack_f32_to_bf16(features["dense"]),
             "sparse": pack_int_to_b22(features["sparse"]),
+        },
+        "labels": batch["labels"].astype(np.uint8),
+    }
+
+
+DEDUP_VOCAB_CAPACITY = 1 << 18   # updated by custom_model()
+_DEDUP_PACKER = None
+
+
+def feed_bulk_dedup(buffer, sizes, metadata=None):
+    """feed_bulk with the dedup'd device wire format
+    (elasticdl_tpu.data.wire, PFOR-style): ids are field-offset +
+    hashed HOST-side into shared-table rows, dedup'd per field into a
+    frequency-ranked unique list + a 1-byte inverse plane with
+    escape-coded exceptions.  On zipf-skewed CTR streams this is ~60-65
+    bytes/example on the link vs the b22 compact format's 99 and the
+    plain format's 160 — and the device also skips the hash/mod (the
+    embeddings consume rows directly).  Pad caps are sticky
+    (wire.DedupPacker) so consecutive batches keep identical shapes."""
+    global _DEDUP_PACKER
+    from elasticdl_tpu.data.wire import DedupPacker, pack_f32_to_bf16
+
+    if _DEDUP_PACKER is None:
+        _DEDUP_PACKER = DedupPacker()
+    batch = feed_bulk(buffer, sizes, metadata)
+    features = batch["features"]
+    rows = hash_field_rows_host(features["sparse"], DEDUP_VOCAB_CAPACITY)
+    return {
+        "features": {
+            "dense": pack_f32_to_bf16(features["dense"]),
+            "sparse": _DEDUP_PACKER.pack(rows),
         },
         "labels": batch["labels"].astype(np.uint8),
     }

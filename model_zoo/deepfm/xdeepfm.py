@@ -34,16 +34,18 @@ from model_zoo.deepfm.deepfm_functional_api import (
     feed,
     feed_bulk,
     feed_bulk_compact,
+    feed_bulk_dedup,
     field_offset_ids,
     loss,
     normalize_dense,
     optimizer,
+    sparse_field_rows,
     sparse_ids,
 )
 
 __all__ = [
     "custom_model", "loss", "optimizer", "feed", "feed_bulk",
-    "feed_bulk_compact",
+    "feed_bulk_compact", "feed_bulk_dedup",
     "eval_metrics_fn", "param_sharding", "RECORD_BYTES", "NUM_DENSE",
     "NUM_SPARSE",
 ]
@@ -84,15 +86,17 @@ class XDeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, features):
-        field_ids = field_offset_ids(sparse_ids(features))  # (B, 26)
+        field_ids, prehashed = sparse_field_rows(       # (B, 26)
+            features, self.vocab_capacity
+        )
 
         emb = DistributedEmbedding(
             self.vocab_capacity, self.embed_dim, hash_input=True,
             name="fm_embedding",
-        )(field_ids)                                        # (B, 26, k)
+        )(field_ids, prehashed=prehashed)                   # (B, 26, k)
         first = DistributedEmbedding(
             self.vocab_capacity, 1, hash_input=True, name="fm_linear",
-        )(field_ids)
+        )(field_ids, prehashed=prehashed)
 
         cin_out = CIN(self.cin_widths, name="cin")(emb)
         cin_logit = nn.Dense(1, name="cin_out")(cin_out)[..., 0]
@@ -123,6 +127,10 @@ def custom_model(
     bf16: bool = False,
     cin_widths: tuple = (64, 64),
 ):
+    from model_zoo.deepfm import deepfm_functional_api as _shared
+
+    # the shared dedup feed hashes host-side with this capacity
+    _shared.DEDUP_VOCAB_CAPACITY = int(vocab_capacity)
     return XDeepFM(
         vocab_capacity=vocab_capacity,
         embed_dim=embed_dim,

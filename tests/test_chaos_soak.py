@@ -55,7 +55,10 @@ from elasticdl_tpu.worker.sync import ModelOwner
 from elasticdl_tpu.worker.trainer import TrainState
 from elasticdl_tpu.worker.worker import Worker
 
-pytestmark = pytest.mark.chaos
+# slow: the soak runs the full cluster twice (determinism check) with
+# multi-hundred-second convergence waits — far over the tier-1 budget on
+# a small box.  Run with `-m chaos` / `-m slow`.
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
 SEED = 20240805
 PLANNED_FAULTS = 12

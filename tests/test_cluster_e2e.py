@@ -39,6 +39,9 @@ def mnist_data(tmp_path_factory):
     return write_dataset(str(root), n_train=256, n_val=0)
 
 
+# slow: launches a real master + real worker OS processes and compiles a
+# full train job in each — minutes of wall clock on a small box.
+@pytest.mark.slow
 def test_cluster_job_bootstraps_from_rendezvous_alone(mnist_data, tmp_path):
     train_dir, _ = mnist_data
     port = _free_port()

@@ -29,6 +29,12 @@ from elasticdl_tpu.master import main as master_main
 from elasticdl_tpu.master.main import Master
 from elasticdl_tpu.common.args import parse_master_args
 
+# slow: every case launches a live multi-process SPMD group (real OS
+# processes, real gRPC, jax.distributed) with multi-minute join budgets —
+# these are the cluster chaos drills (scripts/run_cluster_e2e.sh), far
+# over the tier-1 budget on a small box.  Run with `-m slow`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -1,0 +1,70 @@
+"""The host/device boundary lint (scripts/check_host_device_boundary.py):
+the host data plane must be clean, and the detector itself must catch
+the APIs it documents while ignoring legitimate jnp math."""
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_host_device_boundary.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("hd_boundary", SCRIPT)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _findings(source):
+    return list(_load().find_device_api_uses(ast.parse(source)))
+
+
+def test_detects_device_put_and_friends():
+    assert _findings("import jax\nx = jax.device_put(batch)\n")
+    assert _findings("import jax\nd = jax.devices()[0]\n")
+    assert _findings("import jax\njax.make_array_from_callback(s, f, g)\n")
+    assert _findings("from jax import device_put\n")
+    assert _findings("x.block_until_ready()\n")
+
+
+def test_ignores_jnp_math_and_passed_in_stagers():
+    # device-side unpack helpers (data/wire.py) are jnp math traced from
+    # the consumer's jitted step — not data movement
+    src = (
+        "import jax.numpy as jnp\n"
+        "def unpack(p):\n"
+        "    return jnp.asarray(p['unique']).astype(jnp.int32)\n"
+    )
+    assert not _findings(src)
+    # calling a caller-provided staging hook is the consumer-side
+    # contract, not a device API use in this module
+    assert not _findings("staged.append(device_stage(item))\n")
+    assert not _findings("import numpy as np\nx = np.stack(parts)\n")
+
+
+def test_host_plane_files_cover_data_and_prefetch():
+    mod = _load()
+    files = {
+        os.path.relpath(p, os.path.join(REPO, "elasticdl_tpu"))
+        for p in mod.host_plane_files(os.path.join(REPO, "elasticdl_tpu"))
+    }
+    assert os.path.join("worker", "task_data_service.py") in files
+    assert any(f.startswith("data") for f in files)
+
+
+def test_repo_host_plane_is_clean():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"host/device boundary violations:\n{proc.stdout}{proc.stderr}"
+    )

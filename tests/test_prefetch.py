@@ -72,3 +72,82 @@ def test_overlap_actually_happens():
         i for kind, i, ts in timestamps if ts < consumed[0][1]
     ]
     assert len(produced_before_first_consume) >= 2
+
+
+def test_device_stage_runs_on_consumer_thread_and_preserves_order():
+    """The staging hook (double-buffered H2D overlap) must run on the
+    CONSUMER's thread — the single-device-thread rule
+    (scripts/check_host_device_boundary.py) — and must not reorder or
+    drop items."""
+    consumer = threading.current_thread()
+    staged_on = []
+
+    def stage(item):
+        staged_on.append(threading.current_thread())
+        return ("staged", item)
+
+    out = list(prefetch_batches(iter(range(20)), device_stage=stage))
+    assert out == [("staged", i) for i in range(20)]
+    assert set(staged_on) == {consumer}
+
+
+def test_device_stage_runs_ahead_of_consumption():
+    """With device_depth=1 the hook stages item N+1 while the consumer
+    holds item N: at the moment the FIRST item is yielded, the second
+    must already be staged (that's the double buffer)."""
+    staged = []
+
+    def stage(item):
+        staged.append(item)
+        return item
+
+    it = prefetch_batches(iter(range(5)), device_stage=stage,
+                          device_depth=1)
+    first = next(it)
+    assert first == 0
+    assert staged[:2] == [0, 1]  # second transfer already issued
+    assert list(it) == [1, 2, 3, 4]
+    assert staged == [0, 1, 2, 3, 4]
+
+
+def test_device_stage_error_propagates_and_stops_producer():
+    """A transfer failure (bad shapes, device OOM) must surface to the
+    consumer as the original exception — not wedge the pipeline — and
+    the producer thread must exit."""
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    def stage(item):
+        if item == 3:
+            raise RuntimeError("transfer failed")
+        return item
+
+    before = set(threading.enumerate())
+    out = []
+    with pytest.raises(RuntimeError, match="transfer failed"):
+        for item in prefetch_batches(gen(), device_stage=stage):
+            out.append(item)
+    assert out == [0, 1, 2]
+    for t in threading.enumerate():
+        if t not in before:
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+
+
+def test_reader_error_propagates_through_staged_pipeline():
+    """Reader-side failure with staging active: items staged before the
+    failure still arrive, then the reader's exception surfaces."""
+    def gen():
+        yield 1
+        yield 2
+        raise ValueError("reader died")
+
+    out = []
+    with pytest.raises(ValueError, match="reader died"):
+        for item in prefetch_batches(gen(), device_stage=lambda x: x):
+            out.append(item)
+    assert out == [1, 2]

@@ -126,6 +126,11 @@ def test_save_on_preemption_signal(deepfm_spec, tmp_path):
     saver.close()
 
 
+# slow: crashes the interpreter (SIGSEGV) under the multi-thread virtual
+# CPU device backend — same known backend limitation as the
+# test_elasticity cluster cases (reproduces at clean HEAD, kills the
+# whole tier-1 process with it).  Run with `-m slow`.
+@pytest.mark.slow
 def test_spmd_epoch_bump_restores_and_completes(tmp_path):
     """Mid-job membership change: the SPMD worker re-rendezvouses,
     restores from checkpoint and the job still completes."""

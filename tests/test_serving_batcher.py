@@ -194,3 +194,23 @@ def test_metrics_fill_ratio_and_latency(engine):
     assert snap["latency_p99_s"] > 0.0
     assert batcher.queue_depth == 0
     batcher.shutdown()
+
+
+def test_mixed_payload_forms_split_into_uniform_groups(engine):
+    """Native and uint24-packed payloads (engine.packed_feature_spec)
+    share one queue; arrays of different form can't concatenate, so a
+    gathered batch executes one engine call per run of same-form items,
+    in arrival order, and every request still resolves correctly."""
+    batcher = DynamicBatcher(engine, max_latency_s=10.0)
+    native = lambda: {"x": np.ones((2, 3), np.float32)}  # noqa: E731
+    packed = lambda: {"x": np.ones((2, 3, 3), np.uint8)}  # noqa: E731
+    # 4 x 2 rows = max_batch 8: dispatches as ONE gathered batch,
+    # alternating forms -> 4 uniform groups
+    futures = [batcher.submit(native()), batcher.submit(packed()),
+               batcher.submit(native()), batcher.submit(packed())]
+    results = [f.result(timeout=5) for f in futures]
+    assert [r.code for r in results] == [OK] * 4
+    got = np.concatenate([r.predictions for r in results])
+    np.testing.assert_array_equal(got, np.arange(8))
+    assert len(engine.calls) == 4
+    batcher.shutdown()

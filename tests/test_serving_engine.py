@@ -133,6 +133,52 @@ def test_from_export_rejects_signature_mismatch(spec, export_dir):
         )
 
 
+def test_packed_predict_payload_matches_native():
+    """A Predict client may ship integer id planes uint24-packed
+    (engine.packed_feature_spec, 3 B/id on the request instead of 4);
+    the zoo model unpacks inside the jitted forward, so packed and
+    native payloads must produce identical predictions."""
+    from elasticdl_tpu.common.export import feature_meta
+    from elasticdl_tpu.data.wire import pack_int_to_uint24
+    from elasticdl_tpu.serving.engine import packed_feature_spec
+
+    spec = get_model_spec(
+        "model_zoo", "deepfm.deepfm_functional_api.custom_model",
+        model_params="vocab_capacity=4096;embed_dim=4",
+    )
+    rng = np.random.RandomState(0)
+    sample = {
+        "dense": rng.rand(2, 13).astype(np.float32),
+        "sparse": rng.randint(0, 1 << 22, (2, 26)).astype(np.int32),
+    }
+    variables = dict(spec.model.init(jax.random.PRNGKey(0), sample))
+    engine = ServingEngine(
+        spec.model, variables, step=3,
+        feature_spec=feature_meta(sample), buckets=(4,),
+    )
+
+    pspec = packed_feature_spec(engine.feature_spec)
+    assert pspec["sparse"] == {"shape": [26, 3], "dtype": "uint8"}
+    assert pspec["dense"] == engine.feature_spec["dense"]
+
+    x = {
+        "dense": rng.rand(3, 13).astype(np.float32),
+        "sparse": rng.randint(0, 1 << 22, (3, 26)).astype(np.int32),
+    }
+    packed = {"dense": x["dense"],
+              "sparse": pack_int_to_uint24(x["sparse"])}
+    assert engine.validate(x) is None
+    assert engine.validate(packed) is None
+    # wrong packed width is still rejected
+    bad = {"dense": x["dense"],
+           "sparse": np.zeros((3, 26, 2), np.uint8)}
+    assert "uint24" in engine.validate(bad)
+
+    native_preds, _ = engine.predict(x, 3)
+    packed_preds, _ = engine.predict(packed, 3)
+    np.testing.assert_array_equal(native_preds, packed_preds)
+
+
 def test_from_export_requires_signature_when_meta_lacks_one(
     spec, export_dir, tmp_path
 ):

@@ -197,6 +197,9 @@ _CHILD = textwrap.dedent(
 )
 
 
+# slow: spawns two OS processes that form a jax.distributed mesh and each
+# compile the train step — minutes of wall clock on a small box.
+@pytest.mark.slow
 def test_two_process_training_is_one_model(mnist_data, tmp_path):
     train_dir, _ = mnist_data
     args = parse_master_args(
