@@ -918,12 +918,27 @@ def bench_sparse_path(batch_size: int = 65536):
     }
 
 
+def _maybe_attach_metrics(result):
+    """--emit-metrics: append the unified registry's snapshot to the
+    bench JSON, so a bench run doubles as an instrumentation check (the
+    counters the run exercised — wire pack bytes, rpc totals — show up
+    next to the bench numbers)."""
+    from elasticdl_tpu.common import metrics
+
+    if isinstance(result, dict):
+        result["metrics_snapshot"] = metrics.default_registry().snapshot()
+    return result
+
+
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "full"
+    argv = [a for a in sys.argv[1:] if a != "--emit-metrics"]
+    emit_metrics = len(argv) != len(sys.argv) - 1
+    which = argv[0] if argv else "full"
     which = which.lstrip("-")  # `--serving` and `serving` both work
+    post = _maybe_attach_metrics if emit_metrics else (lambda r: r)
     if which == "all":
         for fn in (bench_deepfm, bench_mnist, bench_bert):
-            print(json.dumps(fn()))
+            print(json.dumps(post(fn())))
     else:
         fn = {"full": bench_full, "deepfm": bench_deepfm,
               "mnist": bench_mnist, "bert": bench_bert,
@@ -931,7 +946,7 @@ def main():
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
               "e2e": lambda: bench_deepfm_e2e()}[which]
-        print(json.dumps(fn()))
+        print(json.dumps(post(fn())))
 
 
 if __name__ == "__main__":

@@ -71,7 +71,18 @@ def _train_local(args, job_type: str = "train") -> int:
             f"elasticdl {job_type} requires --checkpoint_dir_for_init "
             "(evaluating/predicting with random weights is meaningless)"
         )
+    # Same observability surface as the cluster path (master/main.py):
+    # span tracing via --event_log and /metrics + /healthz + /varz via
+    # --telemetry_port.  One process here, so one telemetry server and
+    # one event stream cover master and workers together.
+    from elasticdl_tpu.common import events
+
+    if getattr(args, "event_log", ""):
+        events.configure(args.event_log, role="local")
+    else:
+        events.configure_from_env(role="local")
     master = Master(args)
+    master.start_telemetry(getattr(args, "telemetry_port", 0))
     client = InProcessMasterClient(master.servicer)
     data_origin = {
         "train": args.training_data,
@@ -216,6 +227,12 @@ def serve(args) -> int:
     """`elasticdl serve`: gRPC online inference for a zoo model, from a
     params.msgpack export (--export_dir) or a live checkpoint directory
     (--checkpoint_dir, with hot reload).  docs/SERVING.md."""
+    from elasticdl_tpu.common import events
+
+    if getattr(args, "event_log", ""):
+        events.configure(args.event_log, role="serving")
+    else:
+        events.configure_from_env(role="serving")
     server = build_serving_server(args)
     port = server.start(args.port)
     logger.info(
@@ -297,7 +314,10 @@ def build_serving_server(args):
         max_queue_rows=args.max_queue_rows or None,
         reject_oversized=args.reject_oversized,
     )
-    return ServingServer(engine, batcher, reloader)
+    return ServingServer(
+        engine, batcher, reloader,
+        telemetry_port=getattr(args, "telemetry_port", 0),
+    )
 
 
 def _submit_master_pod(args, job_type: str) -> int:

@@ -51,6 +51,29 @@ def _build_parser() -> argparse.ArgumentParser:
     args_lib.add_serve_params(serve_parser)
     serve_parser.set_defaults(func="serve")
 
+    top_parser = subparsers.add_parser(
+        "top", help="live cluster table from a master's /varz endpoint"
+    )
+    top_parser.add_argument(
+        "master_varz",
+        help="master telemetry address: host:port or http URL "
+        "(--telemetry_port of the master)",
+    )
+    top_parser.add_argument(
+        "--serving_addr", default="",
+        help="optionally also scrape a serving replica's telemetry "
+        "address for a serving summary row",
+    )
+    top_parser.add_argument(
+        "--watch", action="store_true",
+        help="refresh continuously instead of printing one frame",
+    )
+    top_parser.add_argument(
+        "--interval_s", type=float, default=2.0,
+        help="refresh interval with --watch",
+    )
+    top_parser.set_defaults(func="top")
+
     zoo_parser = subparsers.add_parser("zoo", help="model zoo image tools")
     zoo_sub = zoo_parser.add_subparsers(dest="zoo_command")
     zoo_init = zoo_sub.add_parser("init", help="scaffold a model zoo dir")
@@ -93,6 +116,10 @@ def main(argv=None) -> int:
         except ValueError as exc:
             print(f"elasticdl {args.func}: {exc}", file=sys.stderr)
             return 1
+    if args.func == "top":
+        from elasticdl_tpu.client.top import top
+
+        return top(args)
     if args.func == "zoo_init":
         return image_builder.init_zoo(args.model_zoo, args.base_image)
     if args.func == "zoo_build":

@@ -1,0 +1,158 @@
+"""`elasticdl top`: live cluster table from the master's /varz endpoint.
+
+The master aggregates everything `top` shows — task progress, per-worker
+step rates (peeled from task-report exec_counters), pod churn, recovery
+durations, retry/fault counters — into Master.snapshot(), which its
+telemetry server republishes as JSON on /varz (docs/OBSERVABILITY.md).
+`top` is therefore a pure HTTP client: point it at the master's
+--telemetry_port (and optionally a serving replica's) and it renders a
+refreshing table.  stdlib-only on purpose — it must run from any box
+that can reach the port.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Optional
+
+
+def fetch_varz(url: str, timeout_s: float = 5.0) -> dict:
+    """GET a telemetry /varz endpoint.  `url` may be 'host:port' or a
+    full http URL (with or without the /varz path)."""
+    if "://" not in url:
+        url = f"http://{url}"
+    if not url.rstrip("/").endswith("/varz"):
+        url = url.rstrip("/") + "/varz"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt(value, width: int) -> str:
+    if isinstance(value, float):
+        text = f"{value:.2f}"
+    else:
+        text = str(value)
+    return text.rjust(width)
+
+
+def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
+    """One refresh frame: cluster summary + per-worker table (+ serving
+    row when a serving /varz was scraped)."""
+    lines = []
+    snapshot = varz.get("snapshot", {})
+    tasks = snapshot.get("tasks", {})
+    counters = tasks.get("counters", {})
+    metrics = varz.get("metrics", {})
+    lines.append(
+        f"elasticdl top — master pid={varz.get('pid', '?')} "
+        f"role={varz.get('role', '?')} "
+        f"at {time.strftime('%H:%M:%S')}"
+    )
+    lines.append(
+        "tasks: todo={todo} doing={doing} finished={fin} failed={fail} "
+        "recovered={rec} expired={exp} records={records} "
+        "epoch={epoch}/{epochs}".format(
+            todo=tasks.get("todo", 0),
+            doing=tasks.get("doing", 0),
+            fin=counters.get("finished", 0),
+            fail=counters.get("failed", 0),
+            rec=counters.get("recovered", 0),
+            exp=counters.get("expired", 0),
+            records=counters.get("records_done", 0),
+            epoch=tasks.get("epoch", 0),
+            epochs=tasks.get("num_epochs", 0),
+        )
+    )
+    pods = snapshot.get("pods")
+    if pods:
+        lines.append(
+            f"pods: alive={pods.get('alive', 0)} "
+            f"losses={pods.get('losses_seen', 0)} "
+            f"relaunches={pods.get('relaunches', 0)}"
+        )
+    recovery = snapshot.get("recovery")
+    if recovery:
+        durations = recovery.get("recovery_durations_s", [])
+        tail = (
+            " last={:.2f}s".format(durations[-1]) if durations else ""
+        )
+        lines.append(
+            f"recovery: losses={recovery.get('losses', 0)} "
+            f"recovered={recovery.get('recoveries', 0)}"
+            f"{' PENDING' if recovery.get('pending') else ''}{tail}"
+        )
+    resilience = snapshot.get("resilience", {})
+    fault_stats = snapshot.get("faults", {})
+    lines.append(
+        f"rpc: retries={resilience.get('retries', 0)} "
+        f"giveups={resilience.get('giveups', 0)} "
+        f"faults_injected={fault_stats.get('injected', 0)}"
+    )
+    workers = snapshot.get("workers", {})
+    if workers:
+        lines.append("")
+        lines.append(
+            "worker".ljust(8)
+            + "steps".rjust(10)
+            + "steps/s".rjust(10)
+            + "model_step".rjust(12)
+            + "last_report".rjust(14)
+        )
+        now = time.time()
+        for wid in sorted(workers, key=lambda w: int(w)):
+            entry = workers[wid]
+            ago = now - entry.get("last_report_unix_s", now)
+            lines.append(
+                str(wid).ljust(8)
+                + _fmt(entry.get("steps_total", 0), 10)
+                + _fmt(entry.get("steps_per_sec_milli", 0) / 1000.0, 10)
+                + _fmt(entry.get("model_step", 0), 12)
+                + _fmt(f"{ago:.0f}s ago", 14)
+            )
+    if serving_varz is not None:
+        smetrics = serving_varz.get("metrics", {})
+        lines.append("")
+        lines.append(
+            "serving: rows={rows:.0f} shed={shed:.0f} "
+            "p50={p50:.4f}s p99={p99:.4f}s reloads={reloads:.0f} "
+            "model_step={step:.0f}".format(
+                rows=smetrics.get("serving_batch_rows_total", 0.0),
+                shed=smetrics.get(
+                    "serving_requests_rejected_total", 0.0
+                ),
+                p50=smetrics.get("serving_batch_latency_seconds_p50", 0.0),
+                p99=smetrics.get("serving_batch_latency_seconds_p99", 0.0),
+                reloads=smetrics.get("serving_reloads_total", 0.0),
+                step=smetrics.get("serving_model_step", 0.0),
+            )
+        )
+    return "\n".join(lines)
+
+
+def top(args) -> int:
+    """Render the cluster table; --watch refreshes until interrupted."""
+    interval = getattr(args, "interval_s", 2.0)
+    watch = getattr(args, "watch", False)
+    serving_addr = getattr(args, "serving_addr", "")
+    while True:
+        try:
+            varz = fetch_varz(args.master_varz)
+        except Exception as exc:
+            print(f"elasticdl top: cannot scrape {args.master_varz}: {exc}")
+            return 1
+        serving_varz = None
+        if serving_addr:
+            try:
+                serving_varz = fetch_varz(serving_addr)
+            except Exception:
+                pass  # serving replica down: keep showing the master
+        frame = render(varz, serving_varz)
+        if watch:
+            # ANSI clear + home: cheap full-screen refresh, no curses
+            print("\033[2J\033[H" + frame, flush=True)
+            time.sleep(interval)
+        else:
+            print(frame)
+            return 0

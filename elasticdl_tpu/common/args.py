@@ -102,6 +102,21 @@ def add_common_params(parser: argparse.ArgumentParser):
         "polls the instance metadata server instead of a file.",
     )
     parser.add_argument(
+        "--telemetry_port", type=non_neg_int, default=0,
+        help="HTTP port for /metrics (Prometheus text), /healthz and "
+        "/varz on this role (0 = ephemeral).  Workers always bind an "
+        "ephemeral port: their argv is the master's re-serialized argv, "
+        "so a fixed port would collide on shared hosts.",
+    )
+    parser.add_argument(
+        "--event_log", default="",
+        help="Append-only JSONL span-event log (task dispatch/claim/"
+        "train/report, checkpoint save/restore, hot reload, elastic "
+        "recovery).  The master exports the path to its workers via "
+        "ELASTICDL_EVENT_LOG so one file correlates the whole cluster "
+        "(docs/OBSERVABILITY.md).",
+    )
+    parser.add_argument(
         "--wedge_grace_s", type=float, default=20.0,
         help="Seconds a rank may lag a membership-epoch change before its "
         "watchdog assumes it is wedged in a collective with a dead peer "
@@ -290,6 +305,16 @@ def add_serve_params(parser):
     parser.add_argument(
         "--reload_poll_seconds", type=float, default=10.0,
         help="checkpoint-directory poll interval for hot reload",
+    )
+    parser.add_argument(
+        "--telemetry_port", type=non_neg_int, default=0,
+        help="HTTP port for /metrics, /healthz and /varz on the serving "
+        "replica (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--event_log", default="",
+        help="append-only JSONL span-event log (hot-reload events join "
+        "the cluster's trace stream)",
     )
     parser.add_argument(
         "--feature_spec", default="",

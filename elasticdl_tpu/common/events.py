@@ -1,0 +1,142 @@
+"""Cross-process task tracing: append-only JSONL span events.
+
+The control plane already carries the correlation keys — task_id and
+worker_id ride every GetTask/ReportTaskResult RPC — so tracing one task
+across processes needs no new wire format, only a shared log.  Each
+participating process appends one JSON object per line to the SAME file
+(O_APPEND; events are far under PIPE_BUF so concurrent appends from
+master + worker processes do not interleave):
+
+    {"ts": ..., "role": "master", "pid": ..., "event": "task_dispatched",
+     "task_id": 7, "worker_id": 0}
+
+A task's life is then the chain `task_dispatched -> task_claimed ->
+task_trained -> task_reported` filtered by task_id; checkpoint, serving
+hot-reload, and elastic-recovery events share the stream so an operator
+can line a latency spike up against the recovery that caused it.
+
+The log path propagates to subprocess workers through the environment
+(`ELASTICDL_EVENT_LOG`), the same wire `common/faults.py` uses for chaos
+schedules.  Unconfigured processes pay one None-check per emit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+ENV_EVENT_LOG = "ELASTICDL_EVENT_LOG"
+
+# Span-event vocabulary (docs/OBSERVABILITY.md "Span schema").
+TASK_DISPATCHED = "task_dispatched"    # master leased the task
+TASK_CLAIMED = "task_claimed"          # worker received it
+TASK_TRAINED = "task_trained"          # worker finished the shard
+TASK_REPORTED = "task_reported"        # master recorded the result
+CHECKPOINT_SAVED = "checkpoint_saved"
+CHECKPOINT_RESTORED = "checkpoint_restored"
+SERVING_RELOADED = "serving_reloaded"
+RECOVERY_STARTED = "recovery_started"  # worker loss opened an outage
+RECOVERY_DONE = "recovery_done"        # first post-restore progress
+
+_lock = threading.Lock()
+_fh = None
+_path: Optional[str] = None
+_role = ""
+_worker_id: Optional[int] = None
+
+
+def configure(path: Optional[str], role: str = "",
+              worker_id: Optional[int] = None,
+              export_env: bool = False) -> None:
+    """Point this process's event stream at `path` (None disables).
+    `export_env=True` additionally publishes the path to the environment
+    so subprocess workers launched later inherit it."""
+    global _fh, _path, _role, _worker_id
+    with _lock:
+        if _fh is not None:
+            try:
+                _fh.close()
+            except Exception:
+                pass
+            _fh = None
+        _path = path or None
+        _role = role
+        _worker_id = worker_id
+        if _path:
+            directory = os.path.dirname(_path)
+            if directory:
+                os.makedirs(directory, exist_ok=True)
+            _fh = open(_path, "a", buffering=1)
+    if export_env and path:
+        os.environ[ENV_EVENT_LOG] = path
+
+
+def configure_from_env(role: str = "",
+                       worker_id: Optional[int] = None) -> bool:
+    """Subprocess wire: enable tracing when the parent exported a log
+    path.  Returns True when tracing is on."""
+    path = os.environ.get(ENV_EVENT_LOG, "")
+    if path:
+        configure(path, role=role, worker_id=worker_id)
+    return bool(path)
+
+
+def enabled() -> bool:
+    return _fh is not None
+
+
+def emit(event: str, **fields) -> None:
+    """Append one span event.  No-op unless configured; never raises —
+    tracing must not be able to fail the training loop."""
+    fh = _fh
+    if fh is None:
+        return
+    record = {
+        "ts": time.time(),
+        "role": _role,
+        "pid": os.getpid(),
+        "event": event,
+    }
+    if _worker_id is not None and "worker_id" not in fields:
+        record["worker_id"] = _worker_id
+    record.update(fields)
+    try:
+        line = json.dumps(record, sort_keys=True, default=str)
+        with _lock:
+            if _fh is not None:
+                _fh.write(line + "\n")
+    except Exception:
+        pass
+
+
+def read_events(path: str) -> List[dict]:
+    """Parse an event log; malformed lines (torn writes from a killed
+    process) are skipped, not fatal."""
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return []
+    return out
+
+
+def task_chain(events: List[dict], task_id: int) -> List[str]:
+    """The ordered event names recorded for one task — the correlated
+    span chain the e2e test (and an operator) inspects."""
+    return [
+        e["event"] for e in sorted(
+            (e for e in events if e.get("task_id") == task_id),
+            key=lambda e: e.get("ts", 0.0),
+        )
+    ]

@@ -55,6 +55,23 @@ POINTS = (
 
 ACTIONS = ("raise", "delay", "drop")
 
+# Registry-backed injection counters (common/metrics.py): the plan /
+# firing bookkeeping below stays the deterministic-trace source of truth
+# (trace_text), while these series are the cluster-wide observability
+# surface (/metrics, Master.snapshot, `elasticdl top`).
+from elasticdl_tpu.common import metrics as _metrics  # noqa: E402
+
+_hits_counter = _metrics.default_registry().counter(
+    "faults_point_hits_total",
+    "fire() calls per injection point (plan scheduled or not)",
+    labelnames=("point",),
+)
+_injected_counter = _metrics.default_registry().counter(
+    "faults_injected_total",
+    "scheduled faults actually executed, by action",
+    labelnames=("action",),
+)
+
 # Env wire format for subprocess workers (ProcessK8sClient pods): the
 # parent serializes its registry's plan; `configure_from_env()` rebuilds
 # an identical one in the child.
@@ -154,8 +171,10 @@ class FaultRegistry:
             spec = self._plan.get(point, {}).get(hit)
             if spec is not None:
                 self._fired[spec.key()] = spec
+        _hits_counter.labels(point=point).inc()
         if spec is None:
             return
+        _injected_counter.labels(action=spec.action).inc()
         if spec.action == "delay":
             time.sleep(spec.delay_s)
             return

@@ -96,29 +96,47 @@ class LatencyHistogram:
         with self._lock:
             return self._total
 
+    def bucket_snapshot(self):
+        """(uppers, counts, total, sum_s) copied under ONE lock
+        acquisition — the consistent basis for quantiles and for the
+        Prometheus histogram exposition (common/metrics.py), which needs
+        the raw cumulative buckets, not just the derived quantiles."""
+        with self._lock:
+            return (
+                list(self._uppers), list(self._counts),
+                self._total, self._sum_s,
+            )
+
+    @staticmethod
+    def _quantile_from(uppers, counts, total, q: float) -> float:
+        if not total:
+            return 0.0
+        rank = q * (total - 1)
+        seen = 0
+        for idx, c in enumerate(counts):
+            seen += c
+            if seen > rank:
+                return uppers[idx]
+        return uppers[-1]
+
     def quantile(self, q: float) -> float:
         """Upper edge of the bucket holding the q-quantile, in seconds.
         Returns 0.0 before any sample."""
-        with self._lock:
-            if not self._total:
-                return 0.0
-            rank = q * (self._total - 1)
-            seen = 0
-            for idx, c in enumerate(self._counts):
-                seen += c
-                if seen > rank:
-                    return self._uppers[idx]
-            return self._uppers[-1]
+        uppers, counts, total, _ = self.bucket_snapshot()
+        return self._quantile_from(uppers, counts, total, q)
 
     def snapshot(self) -> dict:
-        """{count, mean_s, p50_s, p99_s} — one consistent read."""
-        with self._lock:
-            total, sum_s = self._total, self._sum_s
+        """{count, mean_s, p50_s, p99_s} — one consistent read.  All four
+        numbers derive from a single locked copy of the buckets; the old
+        implementation re-acquired the lock per quantile, so a concurrent
+        `record()` could make count/mean and p50/p99 describe different
+        populations."""
+        uppers, counts, total, sum_s = self.bucket_snapshot()
         return {
             "count": total,
             "mean_s": (sum_s / total) if total else 0.0,
-            "p50_s": self.quantile(0.5),
-            "p99_s": self.quantile(0.99),
+            "p50_s": self._quantile_from(uppers, counts, total, 0.5),
+            "p99_s": self._quantile_from(uppers, counts, total, 0.99),
         }
 
 

@@ -21,11 +21,10 @@ import collections
 import logging
 import os
 import random
-import threading
 import time
 from typing import Callable, Optional
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import faults, metrics
 
 logger = logging.getLogger(__name__)
 
@@ -99,36 +98,49 @@ def is_retryable_error(exc: BaseException) -> bool:
 
 
 # ---- process-wide counters (exported via master/worker snapshots) --------
+# The unified registry (common/metrics.py) IS the storage: /metrics,
+# Master.snapshot(), and these stats() helpers all read the same series.
 
-_stats_lock = threading.Lock()
-_retries: "collections.Counter[str]" = collections.Counter()
-_giveups: "collections.Counter[str]" = collections.Counter()
+_retry_counter = metrics.default_registry().counter(
+    "rpc_client_retries_total",
+    "RPC attempts retried under the shared policy, by call description",
+    labelnames=("call",),
+)
+_giveup_counter = metrics.default_registry().counter(
+    "rpc_client_giveups_total",
+    "RPC calls that exhausted their retry budget, by call description",
+    labelnames=("call",),
+)
 
 
 def _record_retry(description: str) -> None:
-    with _stats_lock:
-        _retries[description or "?"] += 1
+    _retry_counter.labels(call=description or "?").inc()
 
 
 def _record_giveup(description: str) -> None:
-    with _stats_lock:
-        _giveups[description or "?"] += 1
+    _giveup_counter.labels(call=description or "?").inc()
+
+
+def _by_call(counter) -> dict:
+    return {
+        key[0]: int(value)
+        for key, value in sorted(counter.child_values().items())
+        if value
+    }
 
 
 def stats() -> dict:
-    with _stats_lock:
-        return {
-            "retries": sum(_retries.values()),
-            "giveups": sum(_giveups.values()),
-            "retries_by_call": dict(sorted(_retries.items())),
-            "giveups_by_call": dict(sorted(_giveups.items())),
-        }
+    return {
+        "retries": int(_retry_counter.value()),
+        "giveups": int(_giveup_counter.value()),
+        "retries_by_call": _by_call(_retry_counter),
+        "giveups_by_call": _by_call(_giveup_counter),
+    }
 
 
 def reset_stats() -> None:
-    with _stats_lock:
-        _retries.clear()
-        _giveups.clear()
+    _retry_counter.reset()
+    _giveup_counter.reset()
 
 
 class RetryPolicy:

@@ -15,7 +15,7 @@ import json
 import os
 from typing import Any, Dict, Optional
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import events, faults
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
@@ -136,6 +136,7 @@ class CheckpointSaver:
         )
         if saved:
             logger.info("Checkpoint saved at step %d", step)
+            events.emit(events.CHECKPOINT_SAVED, step=step)
         # Manifests cover FINALIZED steps only (async saves commit
         # later); anything committed by now — including earlier async
         # saves — gets its manifest here.
@@ -259,6 +260,7 @@ class CheckpointSaver:
         )
         restored = self._restore_with_shims(step, abstract)
         logger.info("Restored checkpoint step %d (eval-at-version)", step)
+        events.emit(events.CHECKPOINT_RESTORED, step=step)
         return restored
 
     def _restore_with_shims(self, step: int, abstract: Any) -> Any:
@@ -344,6 +346,7 @@ class CheckpointSaver:
                 )
                 continue
             logger.info("Restored checkpoint step %d", step)
+            events.emit(events.CHECKPOINT_RESTORED, step=step)
             return restored
         if last_exc is not None:
             raise last_exc

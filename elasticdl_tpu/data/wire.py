@@ -299,6 +299,21 @@ def dedup_wire_bytes(packed: dict) -> int:
     return sum(np.asarray(v).nbytes for v in packed.values())
 
 
+# Registry series for the host->device wire (common/metrics.py): pack
+# volume was previously only visible inside bench runs; now it feeds
+# /metrics on whichever role runs the packer.
+from elasticdl_tpu.common import metrics as _metrics  # noqa: E402
+
+_pack_bytes_counter = _metrics.default_registry().counter(
+    "data_wire_pack_bytes_total",
+    "bytes produced by DedupPacker.pack for the host->device link",
+)
+_pack_examples_counter = _metrics.default_registry().counter(
+    "data_wire_examples_rows",
+    "example rows packed by DedupPacker.pack",
+)
+
+
 def _round_up(n: int, quantum: int) -> int:
     return max(quantum, ((n + quantum - 1) // quantum) * quantum)
 
@@ -333,4 +348,7 @@ class DedupPacker:
             self.exc_cap = _round_up(
                 int(n_exc * self.headroom), self.quantum
             )
-        return pad_dedup(exact, self.unique_cap, self.exc_cap)
+        packed = pad_dedup(exact, self.unique_cap, self.exc_cap)
+        _pack_bytes_counter.inc(dedup_wire_bytes(packed))
+        _pack_examples_counter.inc(int(np.asarray(rows).shape[0]))
+        return packed

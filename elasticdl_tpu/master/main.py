@@ -180,6 +180,7 @@ class Master:
         self._done = threading.Event()
         self._aborted: Optional[str] = None
         self.bound_port: Optional[int] = None
+        self.telemetry = None
         self.task_manager.add_all_done_callback(self._on_all_done)
         # Final evaluation over the validation set: injected atomically by
         # the task manager the moment the queue first drains (no window in
@@ -359,9 +360,11 @@ class Master:
                     )
 
     def snapshot(self) -> dict:
-        """One observability surface for chaos runs and job-end logging:
-        task progress, recovery durations, pod churn, and the process-wide
-        fault/retry counters."""
+        """One observability surface for chaos runs, job-end logging, and
+        /varz (`elasticdl top`): task progress, recovery durations, pod
+        churn, per-worker telemetry, and the process-wide fault/retry
+        counters — every number read from the unified metrics registry
+        through the components that own it."""
         from elasticdl_tpu.common import faults, resilience
 
         out = {"tasks": self.task_manager.snapshot()}
@@ -369,15 +372,64 @@ class Master:
             out["recovery"] = self.recovery_clock.snapshot()
         if self.pod_manager is not None:
             out["pods"] = self.pod_manager.snapshot()
+        out["workers"] = self.servicer.worker_telemetry()
         out["resilience"] = resilience.stats()
         out["faults"] = faults.stats()
         return out
+
+    def telemetry_registries(self) -> list:
+        """All registries the master exposes on /metrics: the process-wide
+        default plus each per-component registry."""
+        from elasticdl_tpu.common import metrics as metrics_lib
+
+        registries = [
+            metrics_lib.default_registry(),
+            self.task_manager.counters.registry,
+        ]
+        if self.recovery_clock is not None:
+            registries.append(self.recovery_clock.metrics_registry)
+        if self.pod_manager is not None:
+            registries.append(self.pod_manager.metrics_registry)
+        return registries
+
+    def start_telemetry(self, port: int = 0) -> Optional[int]:
+        """Start the /metrics + /healthz + /varz HTTP endpoint; returns
+        the bound port, or None when the server could not start (never
+        fatal — telemetry must not take down the job brain)."""
+        from elasticdl_tpu.common import telemetry as telemetry_lib
+
+        if self.telemetry is not None:
+            return self.telemetry.port
+        self.telemetry = telemetry_lib.TelemetryServer(
+            registries=self.telemetry_registries(),
+            role="master",
+            port=port,
+            healthz_fn=lambda: {
+                "job_finished": self.task_manager.finished,
+                "aborted": self._aborted,
+            },
+            varz_fn=lambda: {
+                "snapshot": self.snapshot(),
+                "grpc_port": self.bound_port,
+            },
+        )
+        try:
+            started = self.telemetry.start()
+            logger.info("Master telemetry on port %d", started)
+            return started
+        except Exception:
+            logger.exception("telemetry server failed to start")
+            self.telemetry = None
+            return None
 
     def stop(self):
         if self.pod_manager is not None:
             self.pod_manager.stop()
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1)
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
 
 
 def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
@@ -408,11 +460,19 @@ def main(argv=None, k8s_client=None, linger_s: float = 5.0) -> int:
             )
     # chaos runs configure the master's fault schedule via the
     # environment, same wire as subprocess workers; no-op otherwise
-    from elasticdl_tpu.common import faults
+    from elasticdl_tpu.common import events, faults
 
     faults.configure_from_env()
+    # structured tracing: --event_log wins; otherwise inherit the env
+    # wire (ELASTICDL_EVENT_LOG).  export_env=True propagates the path
+    # to subprocess workers the same way the fault schedule travels.
+    if getattr(args, "event_log", ""):
+        events.configure(args.event_log, role="master", export_env=True)
+    else:
+        events.configure_from_env(role="master")
     master = Master(args, k8s_client=k8s_client)
     master.start()
+    master.start_telemetry(getattr(args, "telemetry_port", 0))
     ok = master.wait()
     logger.info("Job complete: %s", master.snapshot())
     if master.recovery_clock is not None and master.recovery_clock.history:

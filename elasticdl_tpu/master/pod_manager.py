@@ -14,6 +14,7 @@ import threading
 from typing import Dict, List, Optional
 
 from elasticdl_tpu.common import faults, resilience
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.constants import PodStatus, PodType
 from elasticdl_tpu.common.k8s_client import AbstractK8sClient, PodSpec
 from elasticdl_tpu.common.log_utils import get_logger
@@ -85,9 +86,22 @@ class PodManager:
         self._relaunch_count: Dict[int, int] = {}
         self._phases: Dict[str, str] = {}
         self.stopped = False
-        # chaos-run observability (master snapshot())
-        self._losses_seen = 0
-        self._relaunches = 0
+        # chaos-run observability: registry-backed so snapshot(),
+        # /metrics, and `elasticdl top` all read the same series
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self._losses_seen = self.metrics_registry.counter(
+            "master_pod_losses_total",
+            "worker pods lost (preemption, failure, scale-down)",
+        )
+        self._relaunches = self.metrics_registry.counter(
+            "master_pod_relaunches_total",
+            "replacement worker pods launched after a loss",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_workers_alive_count",
+            lambda: float(len(self._pod_by_worker)),
+            "workers currently in the membership",
+        )
         # Shared resilience policy for apiserver deletes (was a bespoke
         # single-retry loop): NotFound is terminal, anything else gets one
         # backed-off retry before we fall back to the wedge watchdog.
@@ -313,8 +327,7 @@ class PodManager:
                         exit_code=None):
         if self._recovery_clock is not None and not self.stopped:
             self._recovery_clock.mark_loss()
-        with self._lock:
-            self._losses_seen += 1
+        self._losses_seen.inc()
         # 1. failure detector -> task lease recovery (at-least-once)
         if self._tm is not None:
             self._tm.recover_tasks(worker_id)
@@ -373,8 +386,7 @@ class PodManager:
             if not intentional:
                 self._restart_group_peers(group, lost_worker=worker_id)
             # the replacement joins the lost worker's slice group
-            with self._lock:
-                self._relaunches += 1
+            self._relaunches.inc()
             self._launch_worker(new_id, group=group)
         elif none_alive:
             self._on_job_abort(
@@ -441,6 +453,6 @@ class PodManager:
         with self._lock:
             return {
                 "alive": len(self._pod_by_worker),
-                "losses_seen": self._losses_seen,
-                "relaunches": self._relaunches,
+                "losses_seen": int(self._losses_seen.value()),
+                "relaunches": int(self._relaunches.value()),
             }

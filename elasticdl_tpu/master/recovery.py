@@ -7,6 +7,11 @@ membership loss, and the servicer stamps the first training progress that
 follows (report_version from the rebuilt group, or a successful task
 report).  Parity note: the reference had no such measurement — SURVEY.md
 §6 requires baselines to be measured, not transcribed.
+
+Counts and durations live in a per-instance metrics registry
+(common/metrics.py) so the same numbers feed snapshot(), /metrics, and
+`elasticdl top`; the raw `history` list is kept for tests and the
+snapshot's exact-durations field.
 """
 
 from __future__ import annotations
@@ -15,26 +20,54 @@ import threading
 import time
 from typing import List, Optional
 
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
 
 
 class RecoveryClock:
-    def __init__(self):
+    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._pending_since: Optional[float] = None
-        self.losses = 0
         self.history: List[float] = []
+        self.metrics_registry = registry or metrics_lib.MetricsRegistry()
+        self._losses = self.metrics_registry.counter(
+            "master_recovery_losses_total",
+            "worker membership losses observed (preemption/failure/scale)",
+        )
+        self._recoveries = self.metrics_registry.counter(
+            "master_recoveries_total",
+            "closed outages: loss -> first post-restore training progress",
+        )
+        self._duration = self.metrics_registry.histogram(
+            "master_recovery_seconds",
+            "elastic recovery duration (loss -> first progress)",
+            min_value=0.01,
+            max_value=600.0,
+        )
+        self.metrics_registry.gauge_fn(
+            "master_recovery_pending_count",
+            lambda: 1.0 if self._pending_since is not None else 0.0,
+            "1 while an outage is open (loss seen, no progress yet)",
+        )
+
+    @property
+    def losses(self) -> int:
+        return int(self._losses.value())
 
     def mark_loss(self) -> None:
         """A worker left the membership (preemption/failure/scale event).
         The earliest pending loss wins so a multi-loss outage is measured
         end to end."""
         with self._lock:
-            self.losses += 1
-            if self._pending_since is None:
+            self._losses.inc()
+            opened = self._pending_since is None
+            if opened:
                 self._pending_since = time.time()
+        if opened:
+            events.emit(events.RECOVERY_STARTED)
 
     def mark_progress(self) -> Optional[float]:
         """Training progressed; closes a pending outage and returns its
@@ -45,16 +78,19 @@ class RecoveryClock:
             elapsed = time.time() - self._pending_since
             self._pending_since = None
             self.history.append(elapsed)
+            self._recoveries.inc()
+            self._duration.observe(elapsed)
         logger.info(
             "elastic recovery: %.2fs (worker loss -> first post-restore "
             "training progress)", elapsed,
         )
+        events.emit(events.RECOVERY_DONE, duration_s=round(elapsed, 6))
         return elapsed
 
     def snapshot(self) -> dict:
         with self._lock:
             return {
-                "losses": self.losses,
+                "losses": int(self._losses.value()),
                 "recoveries": len(self.history),
                 "recovery_durations_s": list(self.history),
                 "pending": self._pending_since is not None,

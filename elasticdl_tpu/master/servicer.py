@@ -10,11 +10,17 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.master.task_manager import TaskManager
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
 logger = get_logger(__name__)
+
+# exec_counters keys carrying worker telemetry piggybacked on task
+# reports (worker/task_data_service.py) — namespaced with a double
+# underscore so they can never collide with real execution counters.
+TELEMETRY_KEY_PREFIX = "__"
 
 
 class MasterServicer:
@@ -34,6 +40,9 @@ class MasterServicer:
         self._worker_liveness = {}
         self._max_model_version = 0
         self._recovery_clock = recovery_clock
+        # worker_id -> latest telemetry peeled from report exec_counters;
+        # aggregated into Master.snapshot()["workers"] and /varz.
+        self._worker_telemetry = {}
 
     # ---- task dispatch -------------------------------------------------
 
@@ -41,6 +50,12 @@ class MasterServicer:
         task_type = req.task_type if req.filter_by_type else None
         task = self._tm.get(req.worker_id, task_type=task_type)
         if task is not None:
+            events.emit(
+                events.TASK_DISPATCHED,
+                task_id=task.task_id,
+                worker_id=req.worker_id,
+                task_type=task.type,
+            )
             return pb.GetTaskResponse(task=task)
         if self._tm.finished:
             return pb.GetTaskResponse(
@@ -58,6 +73,7 @@ class MasterServicer:
     def report_task_result(self, req: pb.ReportTaskResultRequest, ctx):
         if self._recovery_clock is not None and req.err_message == "":
             self._recovery_clock.mark_progress()
+        self._absorb_telemetry(req)
         self._tm.report(
             req.task_id,
             success=(req.err_message == ""),
@@ -66,7 +82,36 @@ class MasterServicer:
             transient=req.transient,
             model_version=req.exec_counters.get("model_version", -1),
         )
+        events.emit(
+            events.TASK_REPORTED,
+            task_id=req.task_id,
+            worker_id=req.worker_id,
+            success=req.err_message == "",
+        )
         return pb.Empty()
+
+    def _absorb_telemetry(self, req: pb.ReportTaskResultRequest) -> None:
+        """Peel `__`-prefixed keys from exec_counters: worker telemetry
+        riding the existing wire field (milli-units for sub-integer
+        rates, see worker/task_data_service.py)."""
+        entry = None
+        for key, value in req.exec_counters.items():
+            if not key.startswith(TELEMETRY_KEY_PREFIX):
+                continue
+            if entry is None:
+                entry = self._worker_telemetry.setdefault(
+                    req.worker_id, {}
+                )
+            entry[key[len(TELEMETRY_KEY_PREFIX):]] = int(value)
+        if entry is not None:
+            entry["last_report_unix_s"] = int(time.time())
+
+    def worker_telemetry(self) -> dict:
+        """worker_id -> latest reported telemetry (plain dict copy)."""
+        return {
+            wid: dict(entry)
+            for wid, entry in list(self._worker_telemetry.items())
+        }
 
     # ---- evaluation ----------------------------------------------------
 

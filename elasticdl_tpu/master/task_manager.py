@@ -27,9 +27,10 @@ import random
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 
@@ -43,14 +44,96 @@ class _DoingEntry:
     lease_start: float
 
 
-@dataclass
+class _ByTypeView:
+    """Dict-shaped view (int task type -> count) over the labeled
+    by-type counter, so `counters.by_type[t] = ... .get(t, 0) + 1`
+    keeps working against registry storage."""
+
+    def __init__(self, family):
+        self._family = family
+
+    def get(self, task_type: int, default: int = 0) -> int:
+        value = self._family.value(type=str(task_type))
+        return int(value) if value else default
+
+    def __getitem__(self, task_type: int) -> int:
+        return self.get(task_type)
+
+    def __setitem__(self, task_type: int, value: int) -> None:
+        self._family.labels(type=str(task_type)).set(float(value))
+
+    def as_dict(self) -> Dict[int, int]:
+        return {
+            int(key[0]): int(value)
+            for key, value in sorted(self._family.child_values().items())
+            if value
+        }
+
+
 class TaskCounters:
-    finished: int = 0
-    failed: int = 0
-    recovered: int = 0
-    expired: int = 0
-    records_done: int = 0
-    by_type: Dict[int, int] = field(default_factory=dict)
+    """Registry-backed task counters.
+
+    Keeps the historical attribute surface (`counters.finished += 1`,
+    `counters.records_done = n`, `counters.by_type[t]`) while the
+    storage is a metrics registry, so TaskManager.snapshot(), /metrics,
+    and `elasticdl top` all read the same series.
+    """
+
+    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
+        self.registry = registry or metrics_lib.MetricsRegistry()
+        self._finished = self.registry.counter(
+            "master_tasks_finished_total", "tasks reported done"
+        )
+        self._failed = self.registry.counter(
+            "master_tasks_failed_total", "task reports carrying an error"
+        )
+        self._recovered = self.registry.counter(
+            "master_tasks_recovered_total",
+            "leases re-queued after a worker loss",
+        )
+        self._expired = self.registry.counter(
+            "master_tasks_expired_total", "leases reaped by timeout"
+        )
+        self._records = self.registry.counter(
+            "master_task_records_rows", "training records completed"
+        )
+        self._by_type = self.registry.counter(
+            "master_tasks_finished_by_type_total",
+            "tasks reported done, by task type enum value",
+            labelnames=("type",),
+        )
+        self.by_type = _ByTypeView(self._by_type)
+
+    finished = property(
+        lambda self: int(self._finished.value()),
+        lambda self, v: self._finished.set(float(v)),
+    )
+    failed = property(
+        lambda self: int(self._failed.value()),
+        lambda self, v: self._failed.set(float(v)),
+    )
+    recovered = property(
+        lambda self: int(self._recovered.value()),
+        lambda self, v: self._recovered.set(float(v)),
+    )
+    expired = property(
+        lambda self: int(self._expired.value()),
+        lambda self, v: self._expired.set(float(v)),
+    )
+    records_done = property(
+        lambda self: int(self._records.value()),
+        lambda self, v: self._records.set(float(v)),
+    )
+
+    def as_dict(self) -> dict:
+        return {
+            "finished": self.finished,
+            "failed": self.failed,
+            "recovered": self.recovered,
+            "expired": self.expired,
+            "records_done": self.records_done,
+            "by_type": self.by_type.as_dict(),
+        }
 
 
 def create_shards_from_ranges(
@@ -126,6 +209,16 @@ class TaskManager:
         # (ADVICE r2) — another worker gets the window to serve it.
         self._transient_hold: Dict[int, float] = {}
         self.counters = TaskCounters()
+        self.counters.registry.gauge_fn(
+            "master_tasks_todo_count",
+            lambda: float(len(self._todo)),
+            "tasks waiting in the todo queue",
+        )
+        self.counters.registry.gauge_fn(
+            "master_tasks_doing_count",
+            lambda: float(len(self._doing)),
+            "tasks currently leased to workers",
+        )
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
         # Pre-finish providers get one chance to inject final work (e.g.
@@ -616,7 +709,7 @@ class TaskManager:
                 "epoch": self._epoch,
                 "num_epochs": self._num_epochs,
                 "finished": self._finished,
-                "counters": vars(self.counters).copy(),
+                "counters": self.counters.as_dict(),
                 # chaos-run observability: how often shards failed and
                 # re-queued (charged) vs. transiently bounced (uncharged)
                 "task_retries": sum(self._task_retry_count.values()),

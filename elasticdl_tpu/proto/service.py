@@ -15,8 +15,37 @@ traffic lives on the device mesh in the TPU-native design.
 from __future__ import annotations
 
 from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import metrics as _metrics
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
 from elasticdl_tpu.proto import serving_pb2 as spb
+
+# Server-side RPC counters, shared by both transports: the gRPC handler
+# wrapper below and the in-process direct-call path count through the
+# same series, so tests and real-socket runs read identically.
+_requests_counter = _metrics.default_registry().counter(
+    "rpc_server_requests_total",
+    "RPC handler invocations, by service and method",
+    labelnames=("service", "method"),
+)
+_errors_counter = _metrics.default_registry().counter(
+    "rpc_server_errors_total",
+    "RPC handler invocations that raised, by service and method",
+    labelnames=("service", "method"),
+)
+
+
+def _observed(handler, service: str, method: str):
+    """Wrap a (request, context) handler with the request/error series."""
+
+    def _wrapped(request, context):
+        _requests_counter.labels(service=service, method=method).inc()
+        try:
+            return handler(request, context)
+        except Exception:
+            _errors_counter.labels(service=service, method=method).inc()
+            raise
+
+    return _wrapped
 
 SERVICE_NAME = "elasticdl_tpu.Master"
 SERVING_SERVICE_NAME = "elasticdl_tpu.Serving"
@@ -82,7 +111,7 @@ def _add_servicer_to_server(servicer, server, service_name, methods) -> None:
     handlers = {}
     for name, (req_cls, resp_cls) in methods.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(
-            getattr(servicer, name),
+            _observed(getattr(servicer, name), service_name, name),
             request_deserializer=req_cls.FromString,
             response_serializer=lambda msg, _cls=resp_cls: msg.SerializeToString(),
         )
@@ -178,9 +207,13 @@ class _InProcessClientBase:
     _methods: dict
     _fault_points: dict
 
+    _service_name: str = ""
+
     def __init__(self, servicer, retry_policy=None):
         for name in self._methods:
-            method = getattr(servicer, name)
+            method = _observed(
+                getattr(servicer, name), self._service_name, name
+            )
             point = self._fault_points.get(name)
             call = self._make_call(method, point, retry_policy, name)
             setattr(self, name, call)
@@ -205,6 +238,7 @@ class InProcessMasterClient(_InProcessClientBase):
     (the reference exercises its protocol the same way in
     worker_ps_interaction_test.py — SURVEY.md §4.2)."""
 
+    _service_name = SERVICE_NAME
     _methods = MASTER_METHODS
     _fault_points = METHOD_FAULT_POINTS
 
@@ -212,5 +246,6 @@ class InProcessMasterClient(_InProcessClientBase):
 class InProcessServingClient(_InProcessClientBase):
     """Direct-call twin of ServingStub for tests and in-process benches."""
 
+    _service_name = SERVING_SERVICE_NAME
     _methods = SERVING_METHODS
     _fault_points = SERVING_METHOD_FAULT_POINTS

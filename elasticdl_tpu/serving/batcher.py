@@ -35,8 +35,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
-from elasticdl_tpu.common.profiler import LatencyHistogram
 
 logger = get_logger(__name__)
 
@@ -107,52 +107,76 @@ def _resolved(code: int, error: str = "") -> Future:
 
 
 class BatcherMetrics:
-    """Thread-safe counters + latency histogram; snapshot() feeds the
-    Health RPC and the serving bench."""
+    """Registry-backed serving metrics (common/metrics.py): the registry
+    holds the only copy of every counter, and the Health RPC, the serving
+    bench, and the /metrics exposition all read it.  `snapshot()` keeps
+    its historical keys so existing consumers (tests, bench, health
+    probers) are unaffected by the storage change.
 
-    def __init__(self):
-        self.latency = LatencyHistogram()
-        self._lock = threading.Lock()
-        self._ok_rows = 0
-        self._shed = 0
-        self._invalid = 0
-        self._internal = 0
-        self._batches = 0
-        self._fill_sum = 0.0
+    Per-instance registry: each batcher's numbers are its own (many
+    engines/batchers coexist in one test process); the serving server
+    composes this registry into its telemetry surface."""
+
+    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
+        self.registry = registry or metrics_lib.MetricsRegistry()
+        self._rows = self.registry.counter(
+            "serving_batch_rows_total",
+            "rows served successfully, summed over executed batches",
+        )
+        self._batches = self.registry.counter(
+            "serving_batches_total", "batches executed on the engine"
+        )
+        self._fill_sum = self.registry.counter(
+            "serving_batch_fill_sum_total",
+            "sum of per-batch fill fractions rows/bucket; divide by "
+            "serving_batches_total for the mean fill ratio",
+        )
+        self._rejected = self.registry.counter(
+            "serving_requests_rejected_total",
+            "requests resolved without serving, by reason",
+            labelnames=("reason",),
+        )
+        self.latency = self.registry.histogram(
+            "serving_batch_latency_seconds",
+            "enqueue-to-completion latency per request row group",
+        )
+        self.registry.gauge_fn(
+            "serving_batch_fill_ratio",
+            self._mean_fill,
+            "mean batch fill fraction (served rows / bucket capacity)",
+        )
+
+    def _mean_fill(self) -> float:
+        batches = self._batches.value()
+        return self._fill_sum.value() / batches if batches else 0.0
 
     def record_batch(self, rows: int, bucket: int) -> None:
-        with self._lock:
-            self._batches += 1
-            self._ok_rows += rows
-            self._fill_sum += rows / bucket
+        self._batches.inc()
+        self._rows.inc(rows)
+        self._fill_sum.inc(rows / bucket)
 
     def record_shed(self) -> None:
-        with self._lock:
-            self._shed += 1
+        self._rejected.labels(reason="shed").inc()
 
     def record_invalid(self) -> None:
-        with self._lock:
-            self._invalid += 1
+        self._rejected.labels(reason="invalid").inc()
 
     def record_internal(self) -> None:
-        with self._lock:
-            self._internal += 1
+        self._rejected.labels(reason="internal").inc()
 
     def snapshot(self) -> dict:
         lat = self.latency.snapshot()
-        with self._lock:
-            fill = self._fill_sum / self._batches if self._batches else 0.0
-            return {
-                "ok_rows": float(self._ok_rows),
-                "batches": float(self._batches),
-                "batch_fill_ratio": fill,
-                "shed": float(self._shed),
-                "invalid": float(self._invalid),
-                "internal": float(self._internal),
-                "latency_p50_s": lat["p50_s"],
-                "latency_p99_s": lat["p99_s"],
-                "latency_mean_s": lat["mean_s"],
-            }
+        return {
+            "ok_rows": self._rows.value(),
+            "batches": self._batches.value(),
+            "batch_fill_ratio": self._mean_fill(),
+            "shed": self._rejected.labels(reason="shed").value(),
+            "invalid": self._rejected.labels(reason="invalid").value(),
+            "internal": self._rejected.labels(reason="internal").value(),
+            "latency_p50_s": lat["p50_s"],
+            "latency_p99_s": lat["p99_s"],
+            "latency_mean_s": lat["mean_s"],
+        }
 
 
 class DynamicBatcher:
@@ -182,6 +206,11 @@ class DynamicBatcher:
         self._reject_oversized = reject_oversized
         self._clock = clock
         self.metrics = BatcherMetrics()
+        self.metrics.registry.gauge_fn(
+            "serving_queue_depth_rows",
+            lambda: self.queue_depth,
+            "rows currently waiting in the batcher queue",
+        )
         self._queue: deque = deque()
         self._queued_rows = 0
         self._cond = threading.Condition()

@@ -34,6 +34,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.export import (
     SINGLE_FEATURE_KEY,
     feature_meta,
@@ -113,8 +114,22 @@ class ServingEngine:
         self._single = set(self._feature_spec) == {SINGLE_FEATURE_KEY}
         self._has_train = model_has_train_kwarg(model)
         self._lock = threading.Lock()
-        self._trace_count = 0
-        self._swap_count = 0
+        # Per-instance registry (common/metrics.py): compile/swap counts
+        # live ONLY here; the properties below and the Health RPC read
+        # the same series the /metrics exposition renders.
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self._compiles = self.metrics_registry.counter(
+            "serving_engine_compiles_total",
+            "traces of the jitted forward (== distinct compiled buckets)",
+        )
+        self._swaps = self.metrics_registry.counter(
+            "serving_engine_swaps_total",
+            "hot swaps of the served variables (checkpoint reloads)",
+        )
+        self.metrics_registry.gauge_fn(
+            "serving_model_step", lambda: self.step,
+            "training step of the currently served variables",
+        )
         # kept for the reloader: the abstract TrainState this engine's
         # checkpoint restores into (None for export-loaded engines)
         self.state_template = state_template
@@ -122,7 +137,7 @@ class ServingEngine:
         def forward(variables, feats):
             # trace-time side effect: runs once per compile, never on the
             # hot path — this IS the compile counter
-            self._trace_count += 1
+            self._compiles.inc()
             x = feats[SINGLE_FEATURE_KEY] if self._single else feats
             kwargs = {"train": False} if self._has_train else {}
             with mesh_lib.export_mode():
@@ -250,11 +265,11 @@ class ServingEngine:
 
     @property
     def compile_count(self) -> int:
-        return self._trace_count
+        return int(self._compiles.value())
 
     @property
     def swap_count(self) -> int:
-        return self._swap_count
+        return int(self._swaps.value())
 
     @property
     def step(self) -> int:
@@ -325,7 +340,7 @@ class ServingEngine:
             self.predict(_zeros_features(self._feature_spec, b), b)
         logger.info(
             "serving engine warm: buckets=%s compiles=%d",
-            self._buckets, self._trace_count,
+            self._buckets, self.compile_count,
         )
 
     def predict(
@@ -373,7 +388,7 @@ class ServingEngine:
         with self._lock:
             self._variables = variables
             self._step = int(step)
-            self._swap_count += 1
+        self._swaps.inc()
         logger.info("serving engine swapped to step %d", step)
 
 

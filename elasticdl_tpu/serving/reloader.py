@@ -24,7 +24,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.save_utils import CheckpointSaver
 from elasticdl_tpu.worker.trainer import run_device_serialized
@@ -54,8 +55,15 @@ class CheckpointReloader:
         self._saver = CheckpointSaver(checkpoint_dir, async_save=False)
         self._poll_interval_s = poll_interval_s
         self._rejected_steps = set()
-        self.reload_count = 0
-        self.rejected_count = 0
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self._reloads = self.metrics_registry.counter(
+            "serving_reloads_total",
+            "successful checkpoint hot-swaps onto the serving engine",
+        )
+        self._rejected = self.metrics_registry.counter(
+            "serving_reloads_rejected_total",
+            "hot-reload attempts rejected (integrity, restore, injected)",
+        )
         self.last_error: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -84,16 +92,25 @@ class CheckpointReloader:
             )
         except Exception as exc:
             self._rejected_steps.add(latest)
-            self.rejected_count += 1
+            self._rejected.inc()
             self.last_error = str(exc)
             logger.warning(
                 "hot-reload of step %d rejected (%s); still serving "
                 "step %d", latest, exc, self._engine.step,
             )
             return False
-        self.reload_count += 1
+        self._reloads.inc()
         self.last_error = None
+        events.emit(events.SERVING_RELOADED, step=latest)
         return True
+
+    @property
+    def reload_count(self) -> int:
+        return int(self._reloads.value())
+
+    @property
+    def rejected_count(self) -> int:
+        return int(self._rejected.value())
 
     # ---- poll thread ----------------------------------------------------
 

@@ -15,6 +15,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common import telemetry as telemetry_lib
 from elasticdl_tpu.common.export import SINGLE_FEATURE_KEY
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import serving_pb2 as spb
@@ -146,7 +148,8 @@ class ServingServer:
     """Owns the grpc.Server plus the batcher/reloader lifecycle."""
 
     def __init__(self, engine, batcher, reloader=None, workers: int = 16,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 telemetry_port: Optional[int] = 0):
         self._engine = engine
         self._batcher = batcher
         self._reloader = reloader
@@ -157,6 +160,42 @@ class ServingServer:
         self._workers = workers
         self._server = None
         self.port: Optional[int] = None
+        self._telemetry_port = telemetry_port
+        self.telemetry: Optional[telemetry_lib.TelemetryServer] = None
+
+    def telemetry_registries(self) -> list:
+        """All registries this role exposes on /metrics: the process-wide
+        default plus each per-component registry."""
+        registries = [metrics_lib.default_registry()]
+        registry = getattr(self._batcher, "metrics", None)
+        if registry is not None:
+            registries.append(registry.registry)
+        engine_registry = getattr(self._engine, "metrics_registry", None)
+        if engine_registry is not None:
+            registries.append(engine_registry)
+        if self._reloader is not None:
+            registries.append(self._reloader.metrics_registry)
+        return registries
+
+    def _start_telemetry(self) -> None:
+        if self._telemetry_port is None or self.telemetry is not None:
+            return
+        self.telemetry = telemetry_lib.TelemetryServer(
+            registries=self.telemetry_registries(),
+            role="serving",
+            port=self._telemetry_port,
+            healthz_fn=lambda: {
+                "model_step": int(self._engine.step),
+                "queue_depth": int(self._batcher.queue_depth),
+            },
+            varz_fn=lambda: {"grpc_port": self.port},
+        )
+        try:
+            self.telemetry.start()
+            logger.info("serving telemetry on port %d", self.telemetry.port)
+        except Exception:
+            logger.exception("telemetry server failed to start")
+            self.telemetry = None
 
     def start(self, port: int = 0) -> int:
         """Bind (port 0 = ephemeral), start serving; returns the port."""
@@ -180,6 +219,7 @@ class ServingServer:
         if self._reloader is not None:
             self._reloader.start()
         self._server.start()
+        self._start_telemetry()
         logger.info("serving on port %d", self.port)
         return self.port
 
@@ -192,6 +232,9 @@ class ServingServer:
         self._batcher.shutdown()
         if self._reloader is not None:
             self._reloader.stop()
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
 
     def wait(self) -> None:
         if self._server is not None:

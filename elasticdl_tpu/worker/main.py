@@ -131,6 +131,28 @@ def _main(argv=None):
         os.environ.get(WorkerEnv.WORKER_ID, args.worker_id)
     )
     master_addr = os.environ.get(WorkerEnv.MASTER_ADDR, args.master_addr)
+    # Cross-process tracing: --event_log wins; otherwise the master
+    # exported ELASTICDL_EVENT_LOG into our environment (same wire as
+    # the chaos schedule).
+    from elasticdl_tpu.common import events
+
+    if getattr(args, "event_log", ""):
+        events.configure(args.event_log, role="worker",
+                         worker_id=worker_id)
+    else:
+        events.configure_from_env(role="worker", worker_id=worker_id)
+    # /metrics + /healthz + /varz.  Always an ephemeral port: worker argv
+    # is the master's re-serialized argv, so a fixed port would collide
+    # when master and workers share a host (tests, ProcessK8sClient).
+    from elasticdl_tpu.common.telemetry import TelemetryServer
+
+    telemetry = TelemetryServer(role="worker")
+    try:
+        telemetry.start()
+        logger.info("Worker %d telemetry on port %d",
+                    worker_id, telemetry.port)
+    except Exception:
+        logger.exception("telemetry server failed to start")
     from elasticdl_tpu.common.resilience import default_policy
 
     budget = getattr(args, "rpc_retry_budget_s", 0.0)

@@ -203,7 +203,8 @@ class TaskDataService:
             return task, False
 
     def report_task(self, task: pb.Task, err: str = "", records: int = 0,
-                    transient: bool = False, model_version: int = -1):
+                    transient: bool = False, model_version: int = -1,
+                    telemetry: Optional[dict] = None):
         req = pb.ReportTaskResultRequest(
             task_id=task.task_id,
             err_message=err,
@@ -217,6 +218,12 @@ class TaskDataService:
             # when a model checkpoint at >= this step exists (step-based
             # durability — no cross-host clock comparison).
             req.exec_counters["model_version"] = model_version
+        # Worker telemetry rides the existing map field under a `__`
+        # namespace (int64 values — callers pre-scale rates to milli
+        # units); the master's servicer peels these into its snapshot
+        # instead of treating them as execution counters.
+        for key, value in (telemetry or {}).items():
+            req.exec_counters[f"__{key}"] = int(value)
         try:
             self._report_policy.call(
                 lambda: self._client.report_task_result(req),

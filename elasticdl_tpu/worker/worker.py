@@ -19,6 +19,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_handler import ModelSpec, resolve_wire_format
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -27,6 +29,23 @@ from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import Trainer, run_device_serialized
 
 logger = get_logger(__name__)
+
+# Unified registry series (process-wide: one worker per process in
+# cluster mode; in-process tests share them, which is what a
+# cluster-wide total means anyway).  The same numbers ride task reports
+# to the master as `__`-prefixed exec_counters.  Module-level so a
+# Worker built without __init__ (test scaffolding) still counts.
+_steps_counter = metrics_lib.default_registry().counter(
+    "worker_train_steps_total", "optimizer steps completed"
+)
+_steps_gauge = metrics_lib.default_registry().gauge(
+    "worker_steps_per_sec", "rolling step rate (StepTimer window)"
+)
+_tasks_counter = metrics_lib.default_registry().counter(
+    "worker_tasks_total",
+    "tasks processed, by outcome",
+    labelnames=("result",),
+)
 
 
 def _same_batch_shapes(a, b) -> bool:
@@ -252,15 +271,29 @@ class Worker:
                 # the drain check at the top runs
                 continue
             self._maybe_remesh()
+            events.emit(
+                events.TASK_CLAIMED,
+                task_id=task.task_id,
+                worker_id=self.worker_id,
+                task_type=task.type,
+            )
             try:
                 invoke_callbacks(self.spec.callbacks, "on_task_start", task)
                 records = self._process_task(task)
+                events.emit(
+                    events.TASK_TRAINED,
+                    task_id=task.task_id,
+                    worker_id=self.worker_id,
+                    records=records,
+                )
+                _tasks_counter.labels(result="ok").inc()
                 self._data_service.report_task(
                     task,
                     records=records,
                     model_version=self._owner.step
                     if task.type == pb.TRAINING
                     else -1,
+                    telemetry=self._telemetry_payload(),
                 )
                 invoke_callbacks(
                     self.spec.callbacks, "on_task_end", task, records
@@ -280,6 +313,7 @@ class Worker:
                     "Task %d transiently unserviceable on worker %d: %s",
                     task.task_id, self.worker_id, exc,
                 )
+                _tasks_counter.labels(result="transient").inc()
                 self._data_service.report_task(
                     task, err=str(exc), transient=True
                 )
@@ -292,7 +326,19 @@ class Worker:
                 # An exception with an empty str() must still read as a
                 # failure on the wire (err_message=="" means success).
                 err = str(exc) or type(exc).__name__
+                _tasks_counter.labels(result="failed").inc()
                 self._data_service.report_task(task, err=err)
+
+    def _telemetry_payload(self) -> Dict[str, int]:
+        """Telemetry piggybacked on task reports (int64 on the wire;
+        rates pre-scaled to milli units)."""
+        return {
+            "steps_total": int(_steps_counter.value()),
+            "steps_per_sec_milli": int(
+                self.step_timer.steps_per_sec * 1000
+            ),
+            "model_step": int(self._owner.step),
+        }
 
     def _process_task(self, task: pb.Task) -> int:
         if task.type == pb.TRAINING:
@@ -337,6 +383,7 @@ class Worker:
         from elasticdl_tpu.worker.task_data_service import prefetch_batches
 
         records = 0
+        steps = 0
         loss = None
         pending = []
         # Second buffering level (single-step dispatch only): batch k+1's
@@ -369,6 +416,7 @@ class Worker:
                     for held in pending:
                         loss = self._owner.train_batch(held)
                         self.step_timer.tick()
+                        steps += 1
                         self.losses.append(loss)
                     pending.clear()
                 pending.append(batch)
@@ -376,6 +424,7 @@ class Worker:
                     losses = self._owner.train_batch_stack(pending)
                     for _ in pending:
                         self.step_timer.tick()
+                        steps += 1
                     pending.clear()
                     loss = losses[-1]
                     # per-step history, as documented: the scan returns
@@ -384,11 +433,16 @@ class Worker:
                 continue
             loss = self._owner.train_batch(batch)
             self.step_timer.tick()
+            steps += 1
             self.losses.append(loss)
         for batch in pending:
             loss = self._owner.train_batch(batch)
             self.step_timer.tick()
+            steps += 1
             self.losses.append(loss)
+        if steps:
+            _steps_counter.inc(steps)
+            _steps_gauge.set(self.step_timer.steps_per_sec)
         if loss is not None:
             # One scalar write per TASK, not per step: forcing the loss to
             # host every batch would serialize the device pipeline.

@@ -2,8 +2,9 @@
 
 
 def dict_dataset(n: int = 8):
-    import grain
+    from elasticdl_tpu.data.reader.grain_reader import grain_api
 
+    grain = grain_api()
     return grain.MapDataset.range(n).map(
         lambda i: {"image": [i] * 4, "label": i % 2}
     )

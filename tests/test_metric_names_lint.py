@@ -1,0 +1,81 @@
+"""The metric-name lint (scripts/check_metric_names.py): the tree must be
+clean, and the detectors must catch the patterns they document."""
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_metric_names.py")
+
+
+def _load():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("metric_names", SCRIPT)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _name_findings(source):
+    return list(_load().find_bad_metric_names(ast.parse(source)))
+
+
+def _shadow_findings(source):
+    return list(_load().find_shadow_counters(ast.parse(source)))
+
+
+def test_detects_computed_metric_name():
+    src = (
+        "name = 'worker_' + kind + '_total'\n"
+        "registry.counter(name, 'help')\n"
+    )
+    assert _name_findings(src), "computed metric name not detected"
+
+
+def test_detects_rule_breaking_literal_name():
+    # unknown subsystem prefix
+    assert _name_findings("registry.counter('frobnicator_x_total', 'h')\n")
+    # missing unit suffix
+    assert _name_findings("registry.counter('worker_steps', 'h')\n")
+    # not snake_case
+    assert _name_findings("registry.gauge('worker_StepsTotal_total', 'h')\n")
+
+
+def test_accepts_valid_literal_names():
+    assert not _name_findings(
+        "registry.counter('worker_train_steps_total', 'h')\n"
+        "registry.gauge('serving_queue_depth_rows', 'h')\n"
+        "registry.histogram('master_recovery_seconds', 'h')\n"
+    )
+    # unrelated zero-arg attribute calls are not metric creations
+    assert not _name_findings("obj.counter()\n")
+
+
+def test_detects_shadow_counters():
+    assert _shadow_findings("self.reload_count = 0\n")
+    assert _shadow_findings("self._losses_seen = 0\n")
+    assert _shadow_findings("stats = collections.Counter()\n")
+
+
+def test_ignores_non_counter_state():
+    # non-zero init, booleans, non-counter names: all fine
+    assert not _shadow_findings("self.reload_count = 5\n")
+    assert not _shadow_findings("self.stopped = False\n")
+    assert not _shadow_findings("self.unique_cap = 0\n"
+                                .replace("unique_cap", "poll_interval"))
+
+
+def test_repo_tree_is_clean():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        f"metric naming findings:\n{proc.stdout}{proc.stderr}"
+    )

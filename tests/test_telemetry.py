@@ -1,0 +1,522 @@
+"""Cluster-wide telemetry: registry semantics, /metrics exposition over
+HTTP, cross-process span tracing, and `elasticdl top`.
+
+The e2e test runs an in-process master + worker (the Local-mode pattern
+from test_end_to_end_local.py — NOT InProcessCluster, which needs real
+parallelism) with an event log configured, scrapes a live TelemetryServer
+before and after the run, and asserts (a) the Prometheus text parses,
+(b) every counter is monotonic across the two scrapes, and (c) one
+task's span chain reads dispatched -> claimed -> trained -> reported.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common.telemetry import (
+    PROMETHEUS_CONTENT_TYPE,
+    TelemetryServer,
+)
+
+# ---------------------------------------------------------------------------
+# Minimal Prometheus text-format (0.0.4) parser used by the scrape tests.
+# ---------------------------------------------------------------------------
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>\S+)$"
+)
+
+
+def parse_prometheus(text):
+    """Returns ({family: type}, {series: float}); raises AssertionError on
+    any line that is not HELP/TYPE/sample — i.e. the text must parse."""
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _hash, _type, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram"), line
+            types[name] = kind
+        elif line.startswith("#"):
+            raise AssertionError(f"unexpected comment line: {line!r}")
+        else:
+            match = _SERIES_RE.match(line)
+            assert match, f"malformed sample line: {line!r}"
+            series = match.group("name") + (match.group("labels") or "")
+            samples[series] = float(match.group("value"))
+    return types, samples
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10.0) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), \
+            resp.read().decode("utf-8")
+
+
+def _scrape(base):
+    status, ctype, body = _get(base + "/metrics")
+    assert status == 200
+    assert ctype == PROMETHEUS_CONTENT_TYPE
+    return parse_prometheus(body)
+
+
+# ---------------------------------------------------------------------------
+# Registry unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_validate_metric_name():
+    valid = metrics_lib.validate_metric_name
+    assert valid("worker_train_steps_total") is None
+    assert valid("master_recovery_seconds") is None
+    assert valid("serving_queue_depth_rows") is None
+    assert valid("frobnicator_x_total") is not None   # unknown subsystem
+    assert valid("worker_steps") is not None          # missing unit suffix
+    assert valid("worker_StepsTotal_total") is not None  # not snake_case
+    assert valid("worker") is not None                # single token
+
+
+def test_counter_inc_labels_and_family_total():
+    reg = metrics_lib.MetricsRegistry()
+    plain = reg.counter("worker_train_steps_total", "steps")
+    plain.inc()
+    plain.inc(4)
+    assert plain.value() == 5.0
+    with pytest.raises(ValueError):
+        plain.inc(-1)
+
+    labeled = reg.counter(
+        "worker_tasks_total", "by result", labelnames=("result",)
+    )
+    labeled.labels(result="ok").inc(3)
+    labeled.labels(result="failed").inc()
+    assert labeled.value(result="ok") == 3.0
+    assert labeled.value() == 4.0  # no labels on a labeled family: sum
+    # get-or-create: an unseen child reads 0.0, not KeyError
+    assert labeled.value(result="transient") == 0.0
+
+
+def test_registry_rejects_bad_names_and_kind_conflicts():
+    reg = metrics_lib.MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("not_a_subsystem_total")
+    with pytest.raises(ValueError):
+        reg.gauge("worker_steps")  # missing unit suffix
+    reg.counter("worker_train_steps_total")
+    with pytest.raises(ValueError):
+        reg.gauge("worker_train_steps_total")  # registered as counter
+    # same name + same kind is get-or-create, not an error
+    again = reg.counter("worker_train_steps_total")
+    again.inc()
+    assert reg.value("worker_train_steps_total") == 1.0
+
+
+def test_gauge_fn_reads_live_state():
+    reg = metrics_lib.MetricsRegistry()
+    queue = [1, 2, 3]
+    fam = reg.gauge_fn("serving_queue_depth_rows", lambda: len(queue))
+    assert fam.value() == 3.0
+    queue.pop()
+    assert fam.value() == 2.0
+    assert reg.snapshot()["serving_queue_depth_rows"] == 2.0
+
+
+def test_histogram_quantiles_and_snapshot_series():
+    reg = metrics_lib.MetricsRegistry()
+    hist = reg.histogram(
+        "master_recovery_seconds", "outage", min_value=0.01, max_value=600.0
+    )
+    for value in (0.1, 0.2, 0.2, 5.0):
+        hist.observe(value)
+    assert hist.count == 4
+    assert 0.05 <= hist.quantile(0.5) <= 0.5
+    snap = reg.snapshot()
+    assert snap["master_recovery_seconds_count"] == 4.0
+    assert snap["master_recovery_seconds_sum"] == pytest.approx(5.5, rel=0.3)
+    assert "master_recovery_seconds_p50" in snap
+    assert "master_recovery_seconds_p99" in snap
+
+
+def test_render_text_parses_and_composes_registries():
+    a = metrics_lib.MetricsRegistry()
+    b = metrics_lib.MetricsRegistry()
+    a.counter("worker_train_steps_total", "steps").inc(7)
+    a.counter(
+        "worker_tasks_total", labelnames=("result",)
+    ).labels(result="ok").inc(2)
+    b.gauge("serving_model_step_step", "step").set(41)
+    b.histogram("serving_batch_latency_seconds").observe(0.01)
+    # identical (name, labels) series in a later registry replaces
+    b.counter("worker_train_steps_total").inc(9)
+
+    types, samples = parse_prometheus(metrics_lib.render_text([a, b]))
+    assert types["worker_train_steps_total"] == "counter"
+    assert types["serving_model_step_step"] == "gauge"
+    assert types["serving_batch_latency_seconds"] == "histogram"
+    assert samples["worker_train_steps_total"] == 9.0
+    assert samples['worker_tasks_total{result="ok"}'] == 2.0
+    assert samples["serving_model_step_step"] == 41.0
+    assert samples["serving_batch_latency_seconds_count"] == 1.0
+    # histogram buckets are cumulative and end at +Inf == count
+    assert samples['serving_batch_latency_seconds_bucket{le="+Inf"}'] == 1.0
+
+
+def test_render_text_accepts_late_bound_registry_callables():
+    built = []
+
+    def late():
+        return built
+
+    text = metrics_lib.render_text([late])
+    assert text.strip() == ""
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter("data_wire_pack_bytes_total").inc(10)
+    built.append(reg)
+    _, samples = parse_prometheus(metrics_lib.render_text([late]))
+    assert samples["data_wire_pack_bytes_total"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# Event-stream unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_emit_is_noop_when_unconfigured(tmp_path):
+    events.configure(None)
+    assert not events.enabled()
+    events.emit(events.TASK_DISPATCHED, task_id=1)  # must not raise
+
+
+def test_events_roundtrip_and_task_chain(tmp_path):
+    log = str(tmp_path / "events.jsonl")
+    events.configure(log, role="master")
+    try:
+        assert events.enabled()
+        events.emit(events.TASK_DISPATCHED, task_id=3, worker_id=0)
+        events.emit(events.TASK_REPORTED, task_id=3, worker_id=0)
+        events.emit(events.CHECKPOINT_SAVED, step=100)
+    finally:
+        events.configure(None)
+    # a torn write from a killed process must not poison the reader
+    with open(log, "a") as fh:
+        fh.write('{"ts": 1, "event": "task_cl')
+    recorded = events.read_events(log)
+    assert len(recorded) == 3
+    assert all(e["role"] == "master" for e in recorded)
+    assert events.task_chain(recorded, 3) == [
+        events.TASK_DISPATCHED, events.TASK_REPORTED,
+    ]
+    assert events.task_chain(recorded, 99) == []
+
+
+def test_configure_from_env_propagates_to_children(tmp_path, monkeypatch):
+    log = str(tmp_path / "events.jsonl")
+    monkeypatch.delenv(events.ENV_EVENT_LOG, raising=False)
+    events.configure(log, role="master", export_env=True)
+    try:
+        assert events.configure_from_env(role="worker", worker_id=2)
+        events.emit(events.TASK_CLAIMED, task_id=5)
+    finally:
+        events.configure(None)
+        monkeypatch.delenv(events.ENV_EVENT_LOG, raising=False)
+    recorded = events.read_events(log)
+    assert recorded[-1]["worker_id"] == 2  # implicit from configure()
+    assert recorded[-1]["role"] == "worker"
+
+
+# ---------------------------------------------------------------------------
+# TelemetryServer HTTP surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def telemetry():
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter("rpc_server_requests_total", "reqs").inc(12)
+    reg.gauge("master_workers_alive_count").set(2)
+    reg.histogram("master_recovery_seconds").observe(1.5)
+    server = TelemetryServer(
+        registries=[reg],
+        role="master",
+        host="127.0.0.1",
+        varz_fn=lambda: {"grpc_port": 4711},
+        healthz_fn=lambda: {"job_finished": False},
+    )
+    port = server.start()
+    try:
+        yield server, reg, f"http://127.0.0.1:{port}"
+    finally:
+        server.stop()
+
+
+def test_metrics_endpoint_serves_prometheus_text(telemetry):
+    _server, _reg, base = telemetry
+    types, samples = _scrape(base)
+    assert types["rpc_server_requests_total"] == "counter"
+    assert samples["rpc_server_requests_total"] == 12.0
+    assert samples["master_workers_alive_count"] == 2.0
+    assert samples["master_recovery_seconds_count"] == 1.0
+
+
+def test_healthz_and_varz_endpoints(telemetry):
+    _server, _reg, base = telemetry
+    status, ctype, body = _get(base + "/healthz")
+    assert status == 200 and ctype == "application/json"
+    health = json.loads(body)
+    assert health["status"] == "ok"
+    assert health["role"] == "master"
+    assert health["job_finished"] is False
+
+    status, ctype, body = _get(base + "/varz")
+    assert status == 200 and ctype == "application/json"
+    varz = json.loads(body)
+    assert varz["role"] == "master"
+    assert varz["grpc_port"] == 4711
+    assert varz["metrics"]["rpc_server_requests_total"] == 12.0
+
+
+def test_unknown_endpoint_is_404_and_healthz_degrades(telemetry):
+    _server, _reg, base = telemetry
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(base + "/nope")
+    assert err.value.code == 404
+
+    boom = TelemetryServer(
+        registries=[metrics_lib.MetricsRegistry()],
+        role="worker",
+        host="127.0.0.1",
+        healthz_fn=lambda: (_ for _ in ()).throw(RuntimeError("down")),
+    )
+    port = boom.start()
+    try:
+        _status, _ctype, body = _get(f"http://127.0.0.1:{port}/healthz")
+        health = json.loads(body)
+        assert health["status"] == "degraded"
+        assert "down" in health["error"]
+    finally:
+        boom.stop()
+
+
+def test_registries_added_after_start_are_scraped(telemetry):
+    server, _reg, base = telemetry
+    late = metrics_lib.MetricsRegistry()
+    late.counter("serving_reloads_total").inc(3)
+    server.add_registry(late)
+    _, samples = _scrape(base)
+    assert samples["serving_reloads_total"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# e2e: in-process cluster run -> monotonic counters + correlated spans
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mnist_data(tmp_path_factory):
+    from model_zoo.mnist.data import write_dataset
+
+    root = tmp_path_factory.mktemp("mnist_telemetry")
+    return write_dataset(str(root), n_train=128, n_val=64)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    from elasticdl_tpu.common.model_handler import get_model_spec
+
+    return get_model_spec(
+        "model_zoo", "mnist.mnist_functional_api.custom_model"
+    )
+
+
+def test_cluster_run_exposes_metrics_and_traces_tasks(
+    mnist_data, spec, tmp_path
+):
+    from elasticdl_tpu.data.reader import TFRecordDataReader
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_manager import (
+        TaskManager,
+        create_shards_from_ranges,
+    )
+    from elasticdl_tpu.proto.service import InProcessMasterClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    train_dir, _val_dir = mnist_data
+    log = str(tmp_path / "events.jsonl")
+    events.configure(log, role="master")
+    server = None
+    try:
+        reader = TFRecordDataReader(train_dir)
+        tm = TaskManager(
+            training_shards=create_shards_from_ranges(
+                reader.create_shards(), records_per_task=64
+            ),
+            num_epochs=1,
+        )
+        servicer = MasterServicer(tm)
+        client = InProcessMasterClient(servicer)
+        server = TelemetryServer(
+            registries=[
+                metrics_lib.default_registry(),
+                tm.counters.registry,
+            ],
+            role="master",
+            host="127.0.0.1",
+        )
+        base = f"http://127.0.0.1:{server.start()}"
+
+        first_types, first = _scrape(base)
+        worker = Worker(
+            worker_id=0,
+            master_client=client,
+            data_reader=reader,
+            spec=spec,
+            minibatch_size=32,
+        )
+        assert worker.run()
+        second_types, second = _scrape(base)
+
+        # 1. every counter series is monotonic across the two scrapes
+        counters = {
+            name for name, kind in second_types.items() if kind == "counter"
+        }
+        checked = 0
+        for series, value in second.items():
+            family = series.split("{", 1)[0]
+            if family in counters and series in first:
+                assert value >= first[series], series
+                checked += 1
+        assert checked > 0
+
+        # 2. the run showed up in the shared registry surface
+        assert second["master_tasks_finished_total"] == 2.0  # 128/64 shards
+        assert second["master_task_records_rows"] == 128.0
+        assert (
+            second["worker_train_steps_total"]
+            >= first.get("worker_train_steps_total", 0.0) + 4.0
+        )
+        rpc_series = ('rpc_server_requests_total{'
+                      'service="elasticdl_tpu.Master",method="get_task"}')
+        assert second[rpc_series] > first.get(rpc_series, 0.0)
+
+        # 3. master absorbed worker telemetry from report exec_counters
+        telemetry = servicer.worker_telemetry()
+        assert 0 in telemetry
+        assert telemetry[0]["steps_total"] >= 4
+        assert telemetry[0]["model_step"] >= 1
+        assert "last_report_unix_s" in telemetry[0]
+
+        # 4. one task's correlated span chain crosses master and worker
+        recorded = events.read_events(log)
+        task_ids = sorted(
+            {e["task_id"] for e in recorded if "task_id" in e}
+        )
+        assert len(task_ids) == 2
+        for task_id in task_ids:
+            assert events.task_chain(recorded, task_id) == [
+                events.TASK_DISPATCHED,
+                events.TASK_CLAIMED,
+                events.TASK_TRAINED,
+                events.TASK_REPORTED,
+            ]
+    finally:
+        events.configure(None)
+        if server is not None:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# `elasticdl top` against a live /varz
+# ---------------------------------------------------------------------------
+
+
+def _master_like_snapshot():
+    return {
+        "tasks": {
+            "todo": 3, "doing": 1, "epoch": 0, "num_epochs": 2,
+            "counters": {
+                "finished": 7, "failed": 1, "recovered": 2,
+                "expired": 0, "records_done": 448,
+            },
+        },
+        "pods": {"alive": 2, "losses_seen": 1, "relaunches": 1},
+        "recovery": {
+            "losses": 1, "recoveries": 1, "pending": False,
+            "recovery_durations_s": [3.25],
+        },
+        "resilience": {"retries": 4, "giveups": 0},
+        "faults": {"injected": 2},
+        "workers": {
+            "0": {
+                "steps_total": 120, "steps_per_sec_milli": 1500,
+                "model_step": 120, "last_report_unix_s": 0.0,
+            },
+        },
+    }
+
+
+def test_top_renders_cluster_table_from_live_varz(capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.client.top import fetch_varz, render
+
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter("master_tasks_finished_total").inc(7)
+    server = TelemetryServer(
+        registries=[reg],
+        role="master",
+        host="127.0.0.1",
+        varz_fn=lambda: {
+            "snapshot": _master_like_snapshot(), "grpc_port": 4711,
+        },
+    )
+    port = server.start()
+    try:
+        # host:port (no scheme, no path) is normalized to /varz
+        varz = fetch_varz(f"127.0.0.1:{port}")
+        assert varz["snapshot"]["tasks"]["todo"] == 3
+        frame = render(varz)
+        assert "tasks: todo=3 doing=1 finished=7" in frame
+        assert "pods: alive=2 losses=1 relaunches=1" in frame
+        assert "recovery: losses=1 recovered=1 last=3.25s" in frame
+        assert "rpc: retries=4 giveups=0 faults_injected=2" in frame
+        assert "1.50" in frame  # steps/s from steps_per_sec_milli
+        # serving summary line renders from a serving /varz metric dump
+        frame2 = render(
+            varz,
+            serving_varz={
+                "metrics": {
+                    "serving_batch_rows_total": 64.0,
+                    "serving_reloads_total": 2.0,
+                    "serving_model_step": 120.0,
+                }
+            },
+        )
+        assert "serving: rows=64" in frame2
+        assert "reloads=2" in frame2
+
+        # the real subcommand end-to-end: `elasticdl top 127.0.0.1:<port>`
+        rc = cli_main(["top", f"127.0.0.1:{port}"])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "elasticdl top" in printed
+        assert "tasks: todo=3" in printed
+    finally:
+        server.stop()
+
+
+def test_top_reports_unreachable_master(capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    rc = cli_main(["top", "127.0.0.1:1"])  # nothing listens on port 1
+    assert rc == 1
+    assert "cannot scrape" in capsys.readouterr().out
