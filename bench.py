@@ -437,6 +437,17 @@ def bench_deepfm_e2e(
             q.put(("tail", pending))
         q.put(None)
 
+    # Phase attribution over the timed pass only (warm-up/compile and
+    # the host-only pass above must not pollute the breakdown): the
+    # same PhaseTimer hooks the worker loops use — TaskDataService
+    # times pack on the producer thread, the trainer times
+    # h2d_stage/compute, and the q.get below is data_wait.
+    from elasticdl_tpu.common.profiler import PhaseTimer
+
+    phase_timer = PhaseTimer(flush_every=1 << 30)
+    trainer.phase_timer = phase_timer
+    service.phase_timer = phase_timer
+
     t0 = _time.perf_counter()
     producer = _threading.Thread(target=produce, daemon=True)
     producer.start()
@@ -444,7 +455,9 @@ def bench_deepfm_e2e(
     wire_bytes = 0
     n_batches = 0
     while True:
+        t_wait = _time.perf_counter()
         item = q.get()
+        phase_timer.add("data_wait", _time.perf_counter() - t_wait)
         if item is None:
             break
         kind, group = item
@@ -459,6 +472,8 @@ def bench_deepfm_e2e(
         else:
             for batch, _ in group:
                 state, losses = trainer.train_on_batch(state, batch)
+        for _ in group:
+            phase_timer.step_done()
     jax.device_get(losses)
     elapsed = _time.perf_counter() - t0
     e2e = count / elapsed
@@ -503,6 +518,20 @@ def bench_deepfm_e2e(
         best_mb_s / (batch_mb / batch_size), 1
     )
     detail["e2e_link_utilization"] = round(implied_mb_s / best_mb_s, 3)
+    # Where each step's wall time went (docs/OBSERVABILITY.md "Phase
+    # catalogue"): mean seconds per phase per step + the phase's share
+    # of all attributed time.  data_wait ~0 means the host pipeline
+    # kept the device fed; a large h2d_stage share means the link, not
+    # compute, bounds e2e (the transfer-ceiling story above, but
+    # measured in-band).
+    detail["e2e_phase_breakdown"] = {
+        p: {
+            "mean_s_per_step": round(s["mean_s"], 5),
+            "share": round(s["share"], 3),
+        }
+        for p, s in phase_timer.snapshot().items()
+        if s["total_s"] > 0
+    }
     return detail
 
 

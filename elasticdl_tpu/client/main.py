@@ -74,6 +74,14 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     top_parser.set_defaults(func="top")
 
+    trace_parser = subparsers.add_parser(
+        "trace",
+        help="convert an --event_log JSONL to Chrome trace JSON "
+        "(Perfetto / chrome://tracing) or print a latency summary",
+    )
+    args_lib.add_trace_params(trace_parser)
+    trace_parser.set_defaults(func="trace")
+
     zoo_parser = subparsers.add_parser("zoo", help="model zoo image tools")
     zoo_sub = zoo_parser.add_subparsers(dest="zoo_command")
     zoo_init = zoo_sub.add_parser("init", help="scaffold a model zoo dir")
@@ -120,6 +128,10 @@ def main(argv=None) -> int:
         from elasticdl_tpu.client.top import top
 
         return top(args)
+    if args.func == "trace":
+        from elasticdl_tpu.client.trace import trace
+
+        return trace(args)
     if args.func == "zoo_init":
         return image_builder.init_zoo(args.model_zoo, args.base_image)
     if args.func == "zoo_build":

@@ -29,6 +29,22 @@ def fetch_varz(url: str, timeout_s: float = 5.0) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
+def _dominant_phase(entry: dict) -> str:
+    """Where this worker's step time goes: the largest of the cumulative
+    `phase_<name>_ms` telemetry counters, with its share.  '-' until the
+    worker has reported phase telemetry."""
+    phases = {
+        key[len("phase_"):-len("_ms")]: value
+        for key, value in entry.items()
+        if key.startswith("phase_") and key.endswith("_ms") and value
+    }
+    total = sum(phases.values())
+    if not total:
+        return "-"
+    name = max(phases, key=phases.get)
+    return f"{name} {100 * phases[name] / total:.0f}%"
+
+
 def _fmt(value, width: int) -> str:
     if isinstance(value, float):
         text = f"{value:.2f}"
@@ -99,6 +115,8 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
             + "steps/s".rjust(10)
             + "model_step".rjust(12)
             + "last_report".rjust(14)
+            + "top_phase".rjust(16)
+            + "flag".rjust(12)
         )
         now = time.time()
         for wid in sorted(workers, key=lambda w: int(w)):
@@ -110,6 +128,8 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
                 + _fmt(entry.get("steps_per_sec_milli", 0) / 1000.0, 10)
                 + _fmt(entry.get("model_step", 0), 12)
                 + _fmt(f"{ago:.0f}s ago", 14)
+                + _fmt(_dominant_phase(entry), 16)
+                + _fmt("STRAGGLER" if entry.get("straggler") else "-", 12)
             )
     if serving_varz is not None:
         smetrics = serving_varz.get("metrics", {})

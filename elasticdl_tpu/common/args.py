@@ -117,6 +117,20 @@ def add_common_params(parser: argparse.ArgumentParser):
         "(docs/OBSERVABILITY.md).",
     )
     parser.add_argument(
+        "--straggler_multiple", type=float, default=3.0,
+        help="Flag a worker as a straggler when its mean task duration "
+        "exceeds this multiple of the fleet-wide median (rolling window "
+        "of recent tasks).  Flags surface in Master.snapshot()/varz, "
+        "the master_straggler_workers gauge, straggler_detected span "
+        "events and `elasticdl top`.  0 disables detection.",
+    )
+    parser.add_argument(
+        "--straggler_min_tasks", type=pos_int, default=3,
+        help="Minimum completed tasks per worker (and workers in the "
+        "fleet) before straggler detection may flag anyone — avoids "
+        "flagging on compile-warmup noise.",
+    )
+    parser.add_argument(
         "--wedge_grace_s", type=float, default=20.0,
         help="Seconds a rank may lag a membership-epoch change before its "
         "watchdog assumes it is wedged in a collective with a dead peer "
@@ -322,6 +336,30 @@ def add_serve_params(parser):
         "export_meta.json is available: inline JSON "
         '{"name": {"shape": [..], "dtype": ".."}} or a path to an '
         "export_meta.json",
+    )
+
+
+def add_trace_params(parser: argparse.ArgumentParser):
+    """`elasticdl trace`: offline event-log analysis (client/trace.py)."""
+    parser.add_argument(
+        "event_log",
+        help="span-event JSONL written by --event_log (a rolled "
+        "<path>.1 generation, if present, is read automatically)",
+    )
+    parser.add_argument(
+        "--chrome", default="",
+        help="write Chrome trace-event JSON here; open in "
+        "https://ui.perfetto.dev or chrome://tracing",
+    )
+    parser.add_argument(
+        "--summary", action="store_true",
+        help="print per-worker task-latency quantiles, slowest tasks "
+        "and the aggregate step-phase breakdown (default when --chrome "
+        "is not given)",
+    )
+    parser.add_argument(
+        "--slowest", type=non_neg_int, default=5,
+        help="how many slowest tasks the summary lists",
     )
 
 

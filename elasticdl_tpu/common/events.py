@@ -40,21 +40,42 @@ CHECKPOINT_RESTORED = "checkpoint_restored"
 SERVING_RELOADED = "serving_reloaded"
 RECOVERY_STARTED = "recovery_started"  # worker loss opened an outage
 RECOVERY_DONE = "recovery_done"        # first post-restore progress
+STEP_PHASES = "step_phases"            # worker phase-time breakdown flush
+STRAGGLER_DETECTED = "straggler_detected"  # master flagged a slow worker
+
+#: Every event name this stream may carry.  `emit()` callers must pass
+#: one of these constants — scripts/check_metric_names.py rejects string
+#: literals so the vocabulary (and docs/OBSERVABILITY.md) stays the
+#: single source of truth.
+VOCABULARY = frozenset({
+    TASK_DISPATCHED, TASK_CLAIMED, TASK_TRAINED, TASK_REPORTED,
+    CHECKPOINT_SAVED, CHECKPOINT_RESTORED, SERVING_RELOADED,
+    RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
+})
 
 _lock = threading.Lock()
 _fh = None
 _path: Optional[str] = None
 _role = ""
 _worker_id: Optional[int] = None
+_max_bytes: Optional[int] = None
+
+
+def rotated_path(path: str) -> str:
+    """Where `configure(max_bytes=...)` rolls a full log to."""
+    return path + ".1"
 
 
 def configure(path: Optional[str], role: str = "",
               worker_id: Optional[int] = None,
-              export_env: bool = False) -> None:
+              export_env: bool = False,
+              max_bytes: Optional[int] = None) -> None:
     """Point this process's event stream at `path` (None disables).
     `export_env=True` additionally publishes the path to the environment
-    so subprocess workers launched later inherit it."""
-    global _fh, _path, _role, _worker_id
+    so subprocess workers launched later inherit it.  `max_bytes` caps
+    the file: on crossing the cap the log rolls to `<path>.1` (one
+    generation — long soaks can't grow the JSONL unboundedly)."""
+    global _fh, _path, _role, _worker_id, _max_bytes
     with _lock:
         if _fh is not None:
             try:
@@ -65,6 +86,7 @@ def configure(path: Optional[str], role: str = "",
         _path = path or None
         _role = role
         _worker_id = worker_id
+        _max_bytes = int(max_bytes) if max_bytes else None
         if _path:
             directory = os.path.dirname(_path)
             if directory:
@@ -72,6 +94,26 @@ def configure(path: Optional[str], role: str = "",
             _fh = open(_path, "a", buffering=1)
     if export_env and path:
         os.environ[ENV_EVENT_LOG] = path
+
+
+def _maybe_rotate_locked() -> None:
+    """Roll `<path>` to `<path>.1` when past the size cap.  Caller holds
+    `_lock`.  Best-effort: rotation failure must never break emit."""
+    global _fh
+    if _max_bytes is None or _fh is None or _path is None:
+        return
+    try:
+        if _fh.tell() < _max_bytes:
+            return
+        _fh.close()
+        os.replace(_path, rotated_path(_path))
+        _fh = open(_path, "a", buffering=1)
+    except Exception:
+        try:
+            if _fh is None or _fh.closed:
+                _fh = open(_path, "a", buffering=1)
+        except Exception:
+            _fh = None
 
 
 def configure_from_env(role: str = "",
@@ -108,13 +150,12 @@ def emit(event: str, **fields) -> None:
         with _lock:
             if _fh is not None:
                 _fh.write(line + "\n")
+                _maybe_rotate_locked()
     except Exception:
         pass
 
 
-def read_events(path: str) -> List[dict]:
-    """Parse an event log; malformed lines (torn writes from a killed
-    process) are skipped, not fatal."""
+def _read_one(path: str) -> List[dict]:
     out: List[dict] = []
     try:
         with open(path) as fh:
@@ -129,6 +170,14 @@ def read_events(path: str) -> List[dict]:
     except OSError:
         return []
     return out
+
+
+def read_events(path: str) -> List[dict]:
+    """Parse an event log; malformed lines (torn writes from a killed
+    process) are skipped, not fatal.  A rolled generation (`<path>.1`,
+    from `configure(max_bytes=...)`) is read first so the combined list
+    stays in emit order."""
+    return _read_one(rotated_path(path)) + _read_one(path)
 
 
 def task_chain(events: List[dict], task_id: int) -> List[str]:

@@ -67,9 +67,13 @@ def export_model(
     sample_features: Any = None,
 ) -> str:
     os.makedirs(output_dir, exist_ok=True)
+    # owning copies: np.asarray views would alias device buffers that a
+    # later donating train step reuses (parallel/collectives.host_snapshot)
+    from elasticdl_tpu.parallel.collectives import host_snapshot
+
     host_tree = {
-        "params": jax.tree.map(np.asarray, state.params),
-        "model_state": jax.tree.map(np.asarray, state.model_state),
+        "params": host_snapshot(state.params),
+        "model_state": host_snapshot(state.model_state),
     }
     path = os.path.join(output_dir, "params.msgpack")
     with open(path, "wb") as f:

@@ -217,43 +217,84 @@ class _GaugeFnFamily:
 
 class _HistogramFamily:
     """Log-bucketed histogram family reusing LatencyHistogram's bucket
-    scheme (bounded-error quantiles, O(1) observe under a lock)."""
+    scheme (bounded-error quantiles, O(1) observe under a lock).
+
+    Unlabeled (the default) it is a drop-in for a bare LatencyHistogram.
+    With `labelnames`, each label-value combination gets its own child
+    histogram created on first `.labels(...)` — the shape
+    `worker_step_phase_seconds{phase="compute"}` needs."""
 
     kind = HISTOGRAM
-    labelnames: Tuple[str, ...] = ()
 
     def __init__(self, name: str, help: str, min_value: float = 1e-4,
-                 max_value: float = 60.0, growth: float = 1.25):
+                 max_value: float = 60.0, growth: float = 1.25,
+                 labelnames: Sequence[str] = ()):
         self.name = name
         self.help = help
-        self._hist = LatencyHistogram(
+        self.labelnames = _check_labels(labelnames)
+        self._hist_args = dict(
             min_s=min_value, max_s=max_value, growth=growth
         )
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], LatencyHistogram] = {}
+        if not self.labelnames:
+            self._children[()] = LatencyHistogram(**self._hist_args)
+
+    def labels(self, **labelvalues) -> LatencyHistogram:
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labelvalues)}, "
+                f"declared {list(self.labelnames)}"
+            )
+        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = LatencyHistogram(
+                    **self._hist_args
+                )
+            return child
+
+    def child_items(self):
+        """[(label-value tuple, child histogram)] in sorted label order —
+        the per-series iteration exposition needs."""
+        with self._lock:
+            return sorted(self._children.items())
+
+    def _default_child(self) -> LatencyHistogram:
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {list(self.labelnames)}; "
+                "use .labels(...)"
+            )
+        return self._children[()]
 
     def observe(self, value: float) -> None:
-        self._hist.record(value)
+        self._default_child().record(value)
 
     # LatencyHistogram-compatible surface so a registry histogram is a
     # drop-in where a bare LatencyHistogram used to live
     def record(self, value: float) -> None:
-        self._hist.record(value)
+        self._default_child().record(value)
 
     def snapshot(self) -> dict:
-        return self._hist.snapshot()
+        return self._default_child().snapshot()
 
     def quantile(self, q: float) -> float:
-        return self._hist.quantile(q)
+        return self._default_child().quantile(q)
 
     @property
     def count(self) -> int:
-        return self._hist.count
+        with self._lock:
+            children = list(self._children.values())
+        return sum(c.count for c in children)
 
     def mean(self) -> float:
-        snap = self._hist.snapshot()
+        snap = self._default_child().snapshot()
         return snap["mean_s"]
 
     def bucket_snapshot(self):
-        return self._hist.bucket_snapshot()
+        return self._default_child().bucket_snapshot()
 
     def reset(self) -> None:  # pragma: no cover - symmetry with _Family
         pass
@@ -304,12 +345,12 @@ class MetricsRegistry:
         return fam
 
     def histogram(self, name: str, help: str = "", min_value: float = 1e-4,
-                  max_value: float = 60.0,
-                  growth: float = 1.25) -> _HistogramFamily:
+                  max_value: float = 60.0, growth: float = 1.25,
+                  labelnames: Sequence[str] = ()) -> _HistogramFamily:
         fam = self._register(
             name,
             lambda: _HistogramFamily(name, help, min_value, max_value,
-                                     growth),
+                                     growth, labelnames),
         )
         if not isinstance(fam, _HistogramFamily):
             raise ValueError(f"{name} already registered as {fam.kind}")
@@ -339,11 +380,17 @@ class MetricsRegistry:
         out: Dict[str, float] = {}
         for fam in self.families():
             if isinstance(fam, _HistogramFamily):
-                _, _, total, sum_v = fam.bucket_snapshot()
-                out[f"{fam.name}_count"] = float(total)
-                out[f"{fam.name}_sum"] = float(sum_v)
-                out[f"{fam.name}_p50"] = fam.quantile(0.5)
-                out[f"{fam.name}_p99"] = fam.quantile(0.99)
+                for key, hist in fam.child_items():
+                    labelpairs = tuple(zip(fam.labelnames, key))
+                    uppers, counts, total, sum_v = hist.bucket_snapshot()
+                    out[_series_key(f"{fam.name}_count", labelpairs)] = \
+                        float(total)
+                    out[_series_key(f"{fam.name}_sum", labelpairs)] = \
+                        float(sum_v)
+                    out[_series_key(f"{fam.name}_p50", labelpairs)] = \
+                        hist._quantile_from(uppers, counts, total, 0.5)
+                    out[_series_key(f"{fam.name}_p99", labelpairs)] = \
+                        hist._quantile_from(uppers, counts, total, 0.99)
                 continue
             for labelpairs, value in fam.samples():
                 out[_series_key(fam.name, labelpairs)] = value
@@ -408,16 +455,32 @@ def render_text(registries: Iterable) -> str:
         lines.append(f"# TYPE {name} {head.kind}")
         if head.kind == HISTOGRAM:
             for fam in group:
-                uppers, counts, total, sum_v = fam.bucket_snapshot()
-                cumulative = 0
-                for upper, count in zip(uppers, counts):
-                    cumulative += count
-                    lines.append(
-                        f'{name}_bucket{{le="{upper:.6g}"}} {cumulative}'
+                for key, hist in fam.child_items():
+                    labelpairs = tuple(zip(fam.labelnames, key))
+                    inner = ",".join(
+                        f'{ln}="{_escape_label_value(str(lv))}"'
+                        for ln, lv in labelpairs
                     )
-                lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
-                lines.append(f"{name}_sum {sum_v:.9g}")
-                lines.append(f"{name}_count {total}")
+                    sep = "," if inner else ""
+                    uppers, counts, total, sum_v = hist.bucket_snapshot()
+                    cumulative = 0
+                    for upper, count in zip(uppers, counts):
+                        cumulative += count
+                        lines.append(
+                            f'{name}_bucket{{{inner}{sep}'
+                            f'le="{upper:.6g}"}} {cumulative}'
+                        )
+                    lines.append(
+                        f'{name}_bucket{{{inner}{sep}le="+Inf"}} {total}'
+                    )
+                    if inner:
+                        lines.append(
+                            f"{name}_sum{{{inner}}} {sum_v:.9g}"
+                        )
+                        lines.append(f"{name}_count{{{inner}}} {total}")
+                    else:
+                        lines.append(f"{name}_sum {sum_v:.9g}")
+                        lines.append(f"{name}_count {total}")
             continue
         seen: Dict[str, str] = {}
         for fam in group:

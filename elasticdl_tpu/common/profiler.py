@@ -46,6 +46,129 @@ class StepTimer:
         logger.info("%ssteps/sec=%.2f", prefix, self.steps_per_sec)
 
 
+#: The step-phase vocabulary (docs/OBSERVABILITY.md "Phase catalogue").
+#: Every phase a worker attributes step time to; the labeled
+#: `worker_step_phase_seconds{phase=...}` histogram uses exactly these.
+STEP_PHASES = ("data_wait", "pack", "h2d_stage", "compute", "report")
+
+
+class PhaseTimer:
+    """Attributes each train step's wall time to named phases.
+
+    The worker loop wraps each region in `with timer.phase("compute"):`
+    (or calls `add(name, seconds)` for regions timed elsewhere, e.g. on
+    the prefetch producer thread) and calls `step_done()` once per
+    executed step.  Per-phase seconds feed a labeled registry histogram
+    when one is supplied, cumulative totals back the telemetry payload,
+    and every `flush_every` steps the accumulated breakdown is emitted as
+    ONE `step_phases` span event so the attribution survives into the
+    cross-process event log (common/events.py) without a per-step write.
+
+    Thread-safe: `add()` may be called from the prefetch producer thread
+    while the consumer loop runs `phase()`/`step_done()`.
+    """
+
+    def __init__(self, phases=STEP_PHASES, histogram=None,
+                 flush_every: int = 50):
+        import threading
+
+        self.phases = tuple(phases)
+        self._histogram = histogram   # labeled _HistogramFamily or None
+        self._flush_every = max(1, int(flush_every))
+        self._lock = threading.Lock()
+        self._totals = {p: 0.0 for p in self.phases}      # job lifetime
+        self._pending = {p: 0.0 for p in self.phases}     # since last flush
+        self._steps = 0
+        self._pending_steps = 0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - start)
+
+    def add(self, name: str, seconds: float) -> None:
+        if name not in self._totals:
+            return  # unknown phase: attribution must never raise
+        seconds = max(0.0, float(seconds))
+        with self._lock:
+            self._totals[name] += seconds
+            self._pending[name] += seconds
+        if self._histogram is not None:
+            try:
+                self._histogram.labels(phase=name).record(seconds)
+            except Exception:
+                pass
+
+    def step_done(self) -> None:
+        """Count one executed step; flush a `step_phases` span event at
+        the flush interval."""
+        with self._lock:
+            self._steps += 1
+            self._pending_steps += 1
+            if self._pending_steps < self._flush_every:
+                return
+            payload = {
+                p: round(v, 6) for p, v in self._pending.items()
+            }
+            steps = self._pending_steps
+            for p in self._pending:
+                self._pending[p] = 0.0
+            self._pending_steps = 0
+        from elasticdl_tpu.common import events
+
+        events.emit(events.STEP_PHASES, phases=payload, steps=steps)
+
+    def flush(self) -> None:
+        """Force out whatever accumulated since the last flush (end of a
+        task/job: partial windows must not be lost)."""
+        with self._lock:
+            if not self._pending_steps:
+                return
+            payload = {
+                p: round(v, 6) for p, v in self._pending.items()
+            }
+            steps = self._pending_steps
+            for p in self._pending:
+                self._pending[p] = 0.0
+            self._pending_steps = 0
+        from elasticdl_tpu.common import events
+
+        events.emit(events.STEP_PHASES, phases=payload, steps=steps)
+
+    @property
+    def steps(self) -> int:
+        with self._lock:
+            return self._steps
+
+    def snapshot(self) -> dict:
+        """{phase: {"total_s", "mean_s", "share"}} over the job so far.
+        `share` is the phase's fraction of all attributed time."""
+        with self._lock:
+            totals = dict(self._totals)
+            steps = self._steps
+        attributed = sum(totals.values())
+        return {
+            p: {
+                "total_s": t,
+                "mean_s": (t / steps) if steps else 0.0,
+                "share": (t / attributed) if attributed else 0.0,
+            }
+            for p, t in totals.items()
+        }
+
+    def totals_milli(self) -> dict:
+        """{phase: cumulative milliseconds} as ints — the shape the
+        worker's int64 telemetry piggyback (report exec_counters) can
+        carry."""
+        with self._lock:
+            return {
+                p: int(round(v * 1000.0)) for p, v in self._totals.items()
+            }
+
+
 class LatencyHistogram:
     """Thread-safe log-bucketed latency histogram with quantile reads.
 

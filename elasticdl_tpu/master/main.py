@@ -116,6 +116,8 @@ class Master:
             shuffle_seed=0,
             persist_path=persist_path,
             restore_cutoff_step=restore_cutoff,
+            straggler_multiple=getattr(args, "straggler_multiple", 3.0),
+            straggler_min_tasks=getattr(args, "straggler_min_tasks", 3),
         )
         # evaluate-only jobs: the eval round IS the job — inject upfront.
         if self.job_type == "evaluate" and evaluation_shards:
@@ -373,6 +375,11 @@ class Master:
         if self.pod_manager is not None:
             out["pods"] = self.pod_manager.snapshot()
         out["workers"] = self.servicer.worker_telemetry()
+        # Straggler stats come from the task manager's lease clock, not
+        # from worker self-reports — merge them onto the same per-worker
+        # rows so /varz and `elasticdl top` show one table.
+        for wid, stats in self.task_manager.straggler_snapshot().items():
+            out["workers"].setdefault(wid, {}).update(stats)
         out["resilience"] = resilience.stats()
         out["faults"] = faults.stats()
         return out

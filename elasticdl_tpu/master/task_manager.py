@@ -30,6 +30,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -169,6 +170,8 @@ class TaskManager:
         shuffle_seed: Optional[int] = None,
         persist_path: Optional[str] = None,
         restore_cutoff_step: Optional[int] = None,
+        straggler_multiple: float = 3.0,
+        straggler_min_tasks: int = 3,
     ):
         self._lock = threading.Lock()
         self._training_shards = list(training_shards or [])
@@ -218,6 +221,23 @@ class TaskManager:
             "master_tasks_doing_count",
             lambda: float(len(self._doing)),
             "tasks currently leased to workers",
+        )
+        # Straggler detection: the master already observes every training
+        # task's lease->report duration, so flagging a persistently slow
+        # worker costs one rolling window per worker and a median at
+        # report time — no new RPC.  A flagged worker drags every
+        # synchronous collective step (TPU: the whole slice runs at the
+        # straggler's pace), so the flag is the operator's cue to drain
+        # or replace the pod.
+        self._straggler_multiple = float(straggler_multiple)
+        self._straggler_min_tasks = int(straggler_min_tasks)
+        self._worker_task_s: Dict[int, deque] = {}
+        self._stragglers: set = set()
+        self.counters.registry.gauge_fn(
+            "master_straggler_workers_count",
+            lambda: float(len(self._stragglers)),
+            "workers currently flagged as stragglers (mean task "
+            "duration > --straggler_multiple x fleet median)",
         )
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
@@ -527,12 +547,21 @@ class TaskManager:
         stale reports.  `model_version` = the reporter's model step at
         completion (training tasks); journaled for step-based restore
         durability."""
+        newly_flagged = []
         with self._lock:
             entry = self._doing.pop(task_id, None)
             if entry is None:
                 logger.warning("Report for unknown task %d ignored", task_id)
                 return False
             task = entry.task
+            if (
+                success
+                and task.type == pb.TRAINING
+                and entry.worker_id >= 0
+            ):
+                newly_flagged = self._observe_task_duration_locked(
+                    entry.worker_id, time.time() - entry.lease_start
+                )
             if success:
                 self.counters.finished += 1
                 self.counters.records_done += records
@@ -576,17 +605,92 @@ class TaskManager:
                     )
             callbacks = list(self._completion_callbacks)
             fire_done = self._check_all_done_locked()
+        for wid, mean_s, median_s in newly_flagged:
+            logger.warning(
+                "Straggler: worker %d averages %.3fs/task vs fleet "
+                "median %.3fs", wid, mean_s, median_s,
+            )
+            events.emit(
+                events.STRAGGLER_DETECTED,
+                worker_id=wid,
+                mean_task_s=round(mean_s, 6),
+                median_task_s=round(median_s, 6),
+                ratio=round(mean_s / median_s, 3) if median_s else 0.0,
+            )
         for cb in callbacks:
             cb(task, success)
         if fire_done:
             self._fire_all_done()
         return True
 
+    # Rolling window of recent training-task durations per worker: long
+    # enough to smooth task-size variance, short enough that a worker
+    # that RECOVERS (e.g. noisy neighbor went away) un-flags within a
+    # few tasks.
+    STRAGGLER_WINDOW = 20
+
+    def _observe_task_duration_locked(
+        self, worker_id: int, duration_s: float
+    ) -> List[Tuple[int, float, float]]:
+        """Record one completed training task and re-evaluate straggler
+        flags.  Returns newly flagged (worker_id, mean_s, median_s)
+        tuples; the caller emits events outside the lock."""
+        window = self._worker_task_s.setdefault(
+            worker_id, deque(maxlen=self.STRAGGLER_WINDOW)
+        )
+        window.append(max(0.0, float(duration_s)))
+        if self._straggler_multiple <= 0:
+            return []
+        means = {
+            wid: sum(w) / len(w)
+            for wid, w in self._worker_task_s.items()
+            if len(w) >= self._straggler_min_tasks
+        }
+        # A one-worker fleet has no peer to be slower than.
+        if len(means) < 2:
+            self._stragglers.clear()
+            return []
+        # Lower median: in a small even fleet the interpolated median is
+        # dragged up by the straggler's own mean (2 workers: the baseline
+        # becomes the average WITH the outlier and nothing ever flags);
+        # the lower-middle element keeps the baseline at healthy-worker
+        # pace.  For large fleets the difference is negligible.
+        ordered = sorted(means.values())
+        median = ordered[(len(ordered) - 1) // 2]
+        if median <= 0:
+            self._stragglers.clear()
+            return []
+        flagged = {
+            wid for wid, mean in means.items()
+            if mean > self._straggler_multiple * median
+        }
+        newly = flagged - self._stragglers
+        self._stragglers = flagged
+        return [(wid, means[wid], median) for wid in sorted(newly)]
+
+    def straggler_snapshot(self) -> Dict[int, dict]:
+        """worker_id -> rolling task-duration stats + straggler flag,
+        merged into Master.snapshot()['workers'] for /varz and `top`."""
+        with self._lock:
+            return {
+                wid: {
+                    "task_count": len(window),
+                    "mean_task_s": round(sum(window) / len(window), 6),
+                    "straggler": wid in self._stragglers,
+                }
+                for wid, window in self._worker_task_s.items()
+                if window
+            }
+
     def recover_tasks(self, worker_id: int) -> int:
         """Re-queue every in-flight task leased by a (presumed dead) worker.
         Called by the pod manager on pod FAILED/DELETED events."""
         with self._lock:
             self._dead_workers.add(worker_id)
+            # A dead worker's duration window must not skew the fleet
+            # median (or linger as a phantom straggler flag).
+            self._worker_task_s.pop(worker_id, None)
+            self._stragglers.discard(worker_id)
             dead = [
                 tid for tid, e in self._doing.items() if e.worker_id == worker_id
             ]
@@ -714,4 +818,5 @@ class TaskManager:
                 # re-queued (charged) vs. transiently bounced (uncharged)
                 "task_retries": sum(self._task_retry_count.values()),
                 "transient_requeues": sum(self._transient_count.values()),
+                "stragglers": sorted(self._stragglers),
             }

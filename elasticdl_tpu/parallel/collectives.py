@@ -18,6 +18,21 @@ import jax
 import numpy as np
 
 
+def host_snapshot(tree):
+    """Deep, OWNING host copy of a pytree of (possibly sharded) arrays.
+
+    `np.asarray(arr)` on the CPU backend can be a zero-copy VIEW of the
+    device buffer; a later donating step (`jit(..., donate_argnums=...)`)
+    hands that buffer back to XLA for reuse and silently rewrites the
+    "snapshot" in place.  Anything that captures state for later
+    comparison or serialization while training continues (checkpoint
+    reference copies, model export) must copy unconditionally."""
+    return jax.tree.map(
+        lambda x: np.array(x, copy=True) if hasattr(x, "shape") else x,
+        tree,
+    )
+
+
 def host_allgather(x) -> np.ndarray:
     """Gather a (possibly data-sharded) array fully onto EVERY host as a
     numpy value.  Used where device results must reach host-side code that

@@ -36,6 +36,8 @@ from typing import Optional
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common import profiler as profiler_lib
 from elasticdl_tpu.common import resilience
 from elasticdl_tpu.common.jax_compat import distributed_is_initialized
 from elasticdl_tpu.common.log_utils import get_logger
@@ -46,6 +48,19 @@ from elasticdl_tpu.worker.task_data_service import TaskDataService
 from elasticdl_tpu.worker.trainer import Trainer
 
 logger = get_logger(__name__)
+
+# Step-phase attribution: shares the labeled histogram FAMILY with the
+# threaded worker (default_registry get-or-create), but keeps its own
+# timer — SPMD cluster mode runs one rank per process, so per-process
+# totals are per-rank totals.  Module-level for __new__ scaffolding.
+_phase_timer = profiler_lib.PhaseTimer(
+    histogram=metrics_lib.default_registry().histogram(
+        "worker_step_phase_seconds",
+        "per-step wall time attributed to a phase "
+        "(data_wait/pack/h2d_stage/compute/report)",
+        labelnames=("phase",),
+    )
+)
 
 
 def wait_for_confirmed_epoch(
@@ -182,6 +197,7 @@ class SPMDWorker:
         self._data_service = TaskDataService(
             master_client, data_reader, worker_id
         )
+        self._data_service.phase_timer = _phase_timer
         self._reader = data_reader
         self._use_bf16 = use_bf16
         self._seed = seed
@@ -258,6 +274,8 @@ class SPMDWorker:
             use_bf16=self._use_bf16,
             param_sharding_fn=self.spec.param_sharding,
         )
+        # compute / h2d-adjacent dispatch time lands in the phase timer
+        self.trainer.phase_timer = _phase_timer
         logger.info(
             "SPMD rank %d/%d up: %d global devices, mesh %s",
             self.process_id, self.num_processes,
@@ -466,11 +484,13 @@ class SPMDWorker:
         if task.type == pb.TRAINING:
             records = self._train_task(task)
             if self.is_leader:
-                self._data_service.report_task(
-                    task,
-                    records=records,
-                    model_version=int(self.state.step),
-                )
+                with _phase_timer.phase("report"):
+                    self._data_service.report_task(
+                        task,
+                        records=records,
+                        model_version=int(self.state.step),
+                        telemetry=self._telemetry_payload(),
+                    )
                 try:
                     self._client.report_version(
                         pb.ReportVersionRequest(
@@ -529,6 +549,23 @@ class SPMDWorker:
         invoke_callbacks(self.spec.callbacks, "on_task_end", task, records)
         return records
 
+    def _telemetry_payload(self) -> dict:
+        """Leader-rank telemetry piggybacked on task reports (int64 on
+        the wire; rates pre-scaled to milli units) — same shape as
+        Worker._telemetry_payload so the master's snapshot and
+        `elasticdl top` render both worker kinds identically."""
+        payload = {
+            "steps_per_sec_milli": int(
+                self.step_timer.steps_per_sec * 1000
+            ),
+            "model_step": (
+                int(self.state.step) if self.state is not None else 0
+            ),
+        }
+        for phase, ms in _phase_timer.totals_milli().items():
+            payload[f"phase_{phase}_ms"] = ms
+        return payload
+
     def _train_task(self, task: pb.Task) -> int:
         if self._profile_dir and not self._profiled:
             self._profiled = True
@@ -583,11 +620,14 @@ class SPMDWorker:
                 self._recovery_t0 = None
 
         def make_gb(one_batch, one_is_local):
-            if one_is_local:
-                return mesh_lib.make_global_batch_from_local(
-                    one_batch, self.mesh, self.minibatch_size, local[0]
-                )
-            return mesh_lib.make_global_batch(one_batch, self.mesh)
+            # Global-array assembly = this loop's host->device staging.
+            with _phase_timer.phase("h2d_stage"):
+                if one_is_local:
+                    return mesh_lib.make_global_batch_from_local(
+                        one_batch, self.mesh, self.minibatch_size,
+                        local[0],
+                    )
+                return mesh_lib.make_global_batch(one_batch, self.mesh)
 
         def single_step(one_batch, one_is_local, gb=None):
             if gb is None:
@@ -598,6 +638,7 @@ class SPMDWorker:
             self.last_loss = loss
             mark_recovered()
             self.step_timer.tick()
+            _phase_timer.step_done()
             self._maybe_checkpoint()
 
         # steps_per_execution grouping: full groups of slice-local
@@ -630,7 +671,9 @@ class SPMDWorker:
                     make_gb(staged_batch, staged_is_local),
                 )
         # host read/parse overlaps the collective step (double buffering)
-        for item in prefetch_batches(batches, device_stage=device_stage):
+        for item in prefetch_batches(
+            batches, device_stage=device_stage, phase_timer=_phase_timer
+        ):
             batch, real, is_local = item[:3]
             gb = item[3] if len(item) > 3 else None
             self._ensure_state(batch, global_rows=self.minibatch_size)
@@ -642,9 +685,13 @@ class SPMDWorker:
             ):
                 pending.append(batch)
                 if len(pending) == self.steps_per_execution:
-                    stack = mesh_lib.make_global_batch_stack_from_local(
-                        pending, self.mesh, self.minibatch_size, local[0]
-                    )
+                    with _phase_timer.phase("h2d_stage"):
+                        stack = (
+                            mesh_lib.make_global_batch_stack_from_local(
+                                pending, self.mesh,
+                                self.minibatch_size, local[0],
+                            )
+                        )
                     pending = []
                     self.state, losses = (
                         self.trainer.train_on_global_batch_stack(
@@ -655,6 +702,7 @@ class SPMDWorker:
                     mark_recovered()
                     for _ in range(self.steps_per_execution):
                         self.step_timer.tick()
+                        _phase_timer.step_done()
                     self._maybe_checkpoint(
                         stride=self.steps_per_execution
                     )
@@ -667,6 +715,7 @@ class SPMDWorker:
             single_step(batch, is_local, gb=gb)
         for batch in pending:  # task tail: single-step program
             single_step(batch, True)
+        _phase_timer.flush()
         if self.last_loss is not None:
             self._summary.scalars(
                 {

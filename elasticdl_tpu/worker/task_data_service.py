@@ -40,7 +40,7 @@ def _retryable(exc: BaseException) -> bool:
 
 
 def prefetch_batches(iterator, depth: int = 2, device_stage=None,
-                     device_depth: int = 1):
+                     device_depth: int = 1, phase_timer=None):
     """Run a host-side batch iterator (reader IO + feed parsing) in a
     background thread, keeping up to `depth` batches ready while the
     caller's thread drives the device — read/parse overlaps compute (the
@@ -61,7 +61,12 @@ def prefetch_batches(iterator, depth: int = 2, device_stage=None,
     Exceptions from the iterator re-raise at the consumer; a
     device_stage exception also re-raises at the consumer (in yield
     order, never ahead of earlier un-yielded batches).  Abandoning the
-    generator (break / task failure) unblocks and stops the producer."""
+    generator (break / task failure) unblocks and stops the producer.
+
+    `phase_timer` (common/profiler.PhaseTimer), when given, attributes
+    the consumer's BLOCKED time on the queue to the `data_wait` phase —
+    the signal that says "the input pipeline, not the device, is the
+    bottleneck"."""
     import queue
     import threading
 
@@ -96,7 +101,14 @@ def prefetch_batches(iterator, depth: int = 2, device_stage=None,
 
     def consume():
         while True:
-            item = q.get()
+            if phase_timer is None:
+                item = q.get()
+            else:
+                wait_start = time.perf_counter()
+                item = q.get()
+                phase_timer.add(
+                    "data_wait", time.perf_counter() - wait_start
+                )
             if item is sentinel:
                 if error:
                     raise error[0]
@@ -137,6 +149,13 @@ def prefetch_batches(iterator, depth: int = 2, device_stage=None,
 
 
 class TaskDataService:
+    # Step-phase attribution hook (common/profiler.PhaseTimer): feed /
+    # feed_bulk parse time is the `pack` phase.  Class default so bare
+    # instances (test scaffolding) run untimed; the worker runtime
+    # assigns the process-wide timer.  The wrapped feeds usually run on
+    # the prefetch PRODUCER thread — PhaseTimer is thread-safe.
+    phase_timer = None
+
     def __init__(self, master_client, data_reader, worker_id: int,
                  wait_sleep_s: float = 0.5, master_grace_s: float = 30.0,
                  rpc_policy: Optional[resilience.RetryPolicy] = None):
@@ -241,6 +260,22 @@ class TaskDataService:
                 task.task_id, exc,
             )
 
+    def _timed_pack(self, fn: Optional[Callable]) -> Optional[Callable]:
+        """Wrap a feed/feed_bulk callable so its parse time lands in the
+        `pack` phase.  Identity when no timer is configured."""
+        timer = self.phase_timer
+        if timer is None or fn is None:
+            return fn
+
+        def timed(*args, **kwargs):
+            start = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                timer.add("pack", time.perf_counter() - start)
+
+        return timed
+
     # Upper bound on how much of a task's payload the bulk fast path
     # holds in host memory at once (in batches): bounds worker RSS for
     # large records_per_shard zoos without giving up the vectorized
@@ -287,6 +322,8 @@ class TaskDataService:
         per-record loop was the host bottleneck, VERDICT r3 weak #2)."""
         from elasticdl_tpu.parallel.mesh import pad_to_multiple
 
+        feed = self._timed_pack(feed)
+        feed_bulk = self._timed_pack(feed_bulk)
         if feed_bulk is not None:
             reader_bulk = getattr(self._reader, "read_records_bulk", None)
             if reader_bulk is not None:

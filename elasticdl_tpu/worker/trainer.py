@@ -95,6 +95,25 @@ class Trainer:
     optimizer: optax.GradientTransformation    — the zoo's `optimizer()`
     """
 
+    # Step-phase attribution hook (common/profiler.PhaseTimer).  Class
+    # default so trainers built by tests (or through __new__ scaffolding)
+    # run untimed; the worker runtime assigns the process-wide timer.
+    # Trainer-level because BOTH worker loops (threaded and SPMD) end up
+    # here: h2d_stage covers stage_batch, compute covers the train
+    # dispatch (including CPU-backend lock wait — attributing contention
+    # to compute is deliberate: it IS time the step spent not overlapped).
+    phase_timer = None
+
+    def _timed(self, phase_name: str, fn, *args):
+        timer = self.phase_timer
+        if timer is None:
+            return fn(*args)
+        start = time.perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            timer.add(phase_name, time.perf_counter() - start)
+
     def __init__(
         self,
         model,
@@ -327,8 +346,9 @@ class Trainer:
         CPU backend the transfer rides inside the serialized region
         (_CPU_EXEC_LOCK), on TPU it's a plain async enqueue."""
         mesh_lib.set_current_mesh(self.mesh)
-        return run_device_serialized(
-            mesh_lib.shard_batch, batch, self.mesh
+        return self._timed(
+            "h2d_stage", run_device_serialized,
+            mesh_lib.shard_batch, batch, self.mesh,
         )
 
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
@@ -341,7 +361,7 @@ class Trainer:
             sharded = mesh_lib.shard_batch(batch, self.mesh)
             return self.train_step(state, sharded)
 
-        state, loss = run_device_serialized(_step)
+        state, loss = self._timed("compute", run_device_serialized, _step)
         return state, loss
 
     def train_on_batch_stack(self, state, batches):
@@ -352,7 +372,10 @@ class Trainer:
         from elasticdl_tpu.data.wire import is_packed_dedup
 
         mesh_lib.set_current_mesh(self.mesh)
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+        stacked = self._timed(
+            "pack",
+            lambda: jax.tree.map(lambda *xs: np.stack(xs), *batches),
+        )
         sharding = mesh_lib.stacked_data_sharding(self.mesh)
         repl = mesh_lib.replicated(self.mesh)
 
@@ -372,22 +395,26 @@ class Trainer:
             placed = jax.tree.map(put, stacked, is_leaf=is_packed_dedup)
             return self.train_step_many(state, placed)
 
-        return run_device_serialized(_step)
+        return self._timed("compute", run_device_serialized, _step)
 
     def train_on_global_batch_stack(self, state, global_stacked):
         """K-step scan on an already-assembled global (K, B, ...) stack
         (mesh.make_global_batch_stack_from_local) — the multi-process
         steps_per_execution hot path.  Returns (state, losses (K,))."""
         mesh_lib.set_current_mesh(self.mesh)
-        return run_device_serialized(
-            self.train_step_many, state, global_stacked
+        return self._timed(
+            "compute", run_device_serialized,
+            self.train_step_many, state, global_stacked,
         )
 
     def train_on_global_batch(self, state, global_batch):
         """Train step on a batch already assembled into global arrays
         (mesh.make_global_batch) — the multi-process SPMD hot path."""
         mesh_lib.set_current_mesh(self.mesh)
-        return run_device_serialized(self.train_step, state, global_batch)
+        return self._timed(
+            "compute", run_device_serialized,
+            self.train_step, state, global_batch,
+        )
 
     def predict_on_global_batch(self, state, global_features):
         """Forward pass on global arrays; returns the still-global (data-

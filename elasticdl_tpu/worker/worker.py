@@ -21,6 +21,7 @@ import numpy as np
 
 from elasticdl_tpu.common import events
 from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common import profiler as profiler_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.model_handler import ModelSpec, resolve_wire_format
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -46,6 +47,16 @@ _tasks_counter = metrics_lib.default_registry().counter(
     "tasks processed, by outcome",
     labelnames=("result",),
 )
+# Step-phase attribution (ISSUE 5): one process-wide PhaseTimer feeding
+# the labeled histogram, shared by the threaded and SPMD loops.  Module-
+# level for the same __new__ reason as the counters above.
+_phase_hist = metrics_lib.default_registry().histogram(
+    "worker_step_phase_seconds",
+    "per-step wall time attributed to a phase "
+    "(data_wait/pack/h2d_stage/compute/report)",
+    labelnames=("phase",),
+)
+_phase_timer = profiler_lib.PhaseTimer(histogram=_phase_hist)
 
 
 def _same_batch_shapes(a, b) -> bool:
@@ -196,6 +207,11 @@ class Worker:
                 checkpoint_steps=checkpoint_steps,
             )
         self._owner = model_owner
+        # Phase attribution: hand the process-wide timer to the layers
+        # that own each phase (trainer: h2d_stage/compute; data service:
+        # pack; prefetch_batches gets it per-iteration for data_wait).
+        self._owner.trainer.phase_timer = _phase_timer
+        self._data_service.phase_timer = _phase_timer
         self._reader = data_reader
         # Bounded: device arrays, converted lazily; unbounded growth would
         # pin one device buffer per step for the job's lifetime.
@@ -287,14 +303,15 @@ class Worker:
                     records=records,
                 )
                 _tasks_counter.labels(result="ok").inc()
-                self._data_service.report_task(
-                    task,
-                    records=records,
-                    model_version=self._owner.step
-                    if task.type == pb.TRAINING
-                    else -1,
-                    telemetry=self._telemetry_payload(),
-                )
+                with _phase_timer.phase("report"):
+                    self._data_service.report_task(
+                        task,
+                        records=records,
+                        model_version=self._owner.step
+                        if task.type == pb.TRAINING
+                        else -1,
+                        telemetry=self._telemetry_payload(),
+                    )
                 invoke_callbacks(
                     self.spec.callbacks, "on_task_end", task, records
                 )
@@ -332,13 +349,19 @@ class Worker:
     def _telemetry_payload(self) -> Dict[str, int]:
         """Telemetry piggybacked on task reports (int64 on the wire;
         rates pre-scaled to milli units)."""
-        return {
+        payload = {
             "steps_total": int(_steps_counter.value()),
             "steps_per_sec_milli": int(
                 self.step_timer.steps_per_sec * 1000
             ),
             "model_step": int(self._owner.step),
         }
+        # Cumulative per-phase milliseconds: the master diffs/normalizes
+        # these in its snapshot, `elasticdl top` renders the dominant
+        # phase per worker.
+        for phase, ms in _phase_timer.totals_milli().items():
+            payload[f"phase_{phase}_ms"] = ms
+        return payload
 
     def _process_task(self, task: pb.Task) -> int:
         if task.type == pb.TRAINING:
@@ -402,6 +425,7 @@ class Worker:
                 feed_bulk=self._feed_bulk,
             ),
             device_stage=device_stage,
+            phase_timer=_phase_timer,
         ):
             records += real
             if self.steps_per_execution > 1:
@@ -416,6 +440,7 @@ class Worker:
                     for held in pending:
                         loss = self._owner.train_batch(held)
                         self.step_timer.tick()
+                        _phase_timer.step_done()
                         steps += 1
                         self.losses.append(loss)
                     pending.clear()
@@ -424,6 +449,7 @@ class Worker:
                     losses = self._owner.train_batch_stack(pending)
                     for _ in pending:
                         self.step_timer.tick()
+                        _phase_timer.step_done()
                         steps += 1
                     pending.clear()
                     loss = losses[-1]
@@ -433,16 +459,21 @@ class Worker:
                 continue
             loss = self._owner.train_batch(batch)
             self.step_timer.tick()
+            _phase_timer.step_done()
             steps += 1
             self.losses.append(loss)
         for batch in pending:
             loss = self._owner.train_batch(batch)
             self.step_timer.tick()
+            _phase_timer.step_done()
             steps += 1
             self.losses.append(loss)
         if steps:
             _steps_counter.inc(steps)
             _steps_gauge.set(self.step_timer.steps_per_sec)
+            # partial flush window: the task boundary must not strand
+            # accumulated phase time (the trace exporter reads these)
+            _phase_timer.flush()
         if loss is not None:
             # One scalar write per TASK, not per step: forcing the loss to
             # host every batch would serialize the device pipeline.

@@ -2,7 +2,7 @@
 """Lint: registry metrics use literal, `subsystem_name_unit` names, and
 instrumented modules do not grow private counter bookkeeping back.
 
-Two rules over elasticdl_tpu/:
+Three rules over elasticdl_tpu/:
 
 1. **Name discipline.**  Every metric-creation call
    (`*.counter(...)`, `*.gauge(...)`, `*.gauge_fn(...)`,
@@ -21,6 +21,14 @@ Two rules over elasticdl_tpu/:
    the private tallies the registry replaced (ISSUE: register, don't
    rebuild).  Legitimate non-metric state is allowlisted per
    (module, attribute).
+
+3. **Span-event vocabulary.**  `events.emit(...)` must name its event
+   via a `events.<CONSTANT>` attribute, never a string literal — the
+   constants in common/events.py (and their VOCABULARY set) are the
+   single source of truth the trace exporter (client/trace.py) and
+   docs/OBSERVABILITY.md key on; a stringly-typed event silently falls
+   off every consumer.  common/events.py itself (the definitions) is
+   exempt.
 
 Exit status: 0 when clean, 1 with one `path:line: message` per finding.
 """
@@ -101,6 +109,26 @@ def find_bad_metric_names(tree: ast.AST):
             yield (node.lineno, f"metric {name!r}: {error}")
 
 
+def find_stringly_events(tree: ast.AST):
+    """Yield (lineno, message) for `emit("...")` calls that bypass the
+    common/events.py constant vocabulary."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args):
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield (
+                node.lineno,
+                f"emit({first.value!r}, ...): pass an events.<CONSTANT> "
+                "from common/events.py, not a string literal — the "
+                "vocabulary is what the trace exporter and "
+                "docs/OBSERVABILITY.md key on",
+            )
+
+
 def find_shadow_counters(tree: ast.AST):
     """Yield (lineno, message) for private tallies in instrumented
     modules: `self.x = 0` counter-shaped attrs and collections.Counter
@@ -147,6 +175,8 @@ def check_file(path: str, rel: str):
     except SyntaxError as exc:
         return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
     findings = list(find_bad_metric_names(tree))
+    if rel != os.path.join("elasticdl_tpu", "common", "events.py"):
+        findings.extend(find_stringly_events(tree))
     if rel in INSTRUMENTED:
         findings.extend(
             (lineno, message)

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from elasticdl_tpu.common.model_handler import get_model_spec
 from elasticdl_tpu.common.save_utils import CheckpointSaver
 from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.parallel.collectives import host_snapshot
 from elasticdl_tpu.worker.trainer import Trainer
 
 
@@ -70,8 +71,10 @@ def test_restore_checkpoint_onto_smaller_mesh(deepfm_spec, tmp_path):
     saver.save(state, force=True)
     saver.wait_until_finished()
     # host snapshot BEFORE the continuation step (train_step donates its
-    # state argument, deleting the old buffers)
-    params_at_ckpt = jax.tree.map(np.asarray, state.params)
+    # state argument, deleting the old buffers).  Must be an OWNING copy:
+    # np.asarray views alias the donated buffers, which XLA reuses — the
+    # "reference" would silently drift to the continuation step's values.
+    params_at_ckpt = host_snapshot(state.params)
     # the 8-device run's continuation = the reference trajectory
     ref_state, ref_loss = trainer8.train_on_batch(state, _deepfm_batch(16, 3))
 
