@@ -86,7 +86,27 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
         lines.append(
             f"pods: alive={pods.get('alive', 0)} "
             f"losses={pods.get('losses_seen', 0)} "
-            f"relaunches={pods.get('relaunches', 0)}"
+            f"relaunches={pods.get('relaunches', 0)} "
+            f"evictions={pods.get('evictions', 0)}"
+        )
+    policy = snapshot.get("policy")
+    if policy:
+        decisions = policy.get("decisions", [])
+        last = decisions[-1] if decisions else None
+        last_text = (
+            f" last={last['action']}/{last['reason']}@t{last['tick']}"
+            if last else ""
+        )
+        state = (
+            "off" if policy.get("interval_s", 0) <= 0
+            else f"every {policy['interval_s']:.0f}s"
+        )
+        lines.append(
+            f"policy [{state}]: ticks={policy.get('ticks', 0)} "
+            f"backlog/worker={policy.get('backlog_per_worker', 0.0):.2f} "
+            f"data_wait={policy.get('data_wait_ratio', 0.0):.2f} "
+            f"evictions={policy.get('evictions_used', 0)}"
+            f"/{policy.get('eviction_budget', 0)}{last_text}"
         )
     recovery = snapshot.get("recovery")
     if recovery:
@@ -116,7 +136,7 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
             + "model_step".rjust(12)
             + "last_report".rjust(14)
             + "top_phase".rjust(16)
-            + "flag".rjust(12)
+            + "flag".rjust(14)
         )
         now = time.time()
         for wid in sorted(workers, key=lambda w: int(w)):
@@ -129,7 +149,13 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
                 + _fmt(entry.get("model_step", 0), 12)
                 + _fmt(f"{ago:.0f}s ago", 14)
                 + _fmt(_dominant_phase(entry), 16)
-                + _fmt("STRAGGLER" if entry.get("straggler") else "-", 12)
+                + _fmt(
+                    "STRAGGLER {:.0f}s".format(
+                        entry.get("flagged_for_s", 0.0)
+                    )
+                    if entry.get("straggler") else "-",
+                    14,
+                )
             )
     if serving_varz is not None:
         smetrics = serving_varz.get("metrics", {})

@@ -130,6 +130,68 @@ def add_common_params(parser: argparse.ArgumentParser):
         "fleet) before straggler detection may flag anyone — avoids "
         "flagging on compile-warmup noise.",
     )
+    # ---- policy engine (master/policy.py, docs/ROBUSTNESS.md) --------
+    parser.add_argument(
+        "--policy_interval", type=float, default=0.0,
+        help="Seconds between policy-engine ticks (straggler eviction + "
+        "autoscaling).  0 (the default) disables the control loop; the "
+        "sensors keep running either way.",
+    )
+    parser.add_argument(
+        "--min_workers", type=pos_int, default=1,
+        help="Autoscaling floor: the policy engine never scales the "
+        "fleet below this many workers.",
+    )
+    parser.add_argument(
+        "--max_workers", type=int, default=0,
+        help="Autoscaling ceiling.  0 means --num_workers (a fixed "
+        "fleet unless raised).",
+    )
+    parser.add_argument(
+        "--straggler_dwell_s", type=float, default=30.0,
+        help="A straggler flag must persist this long before the policy "
+        "engine evicts the worker — transient flags clear on their own.",
+    )
+    parser.add_argument(
+        "--eviction_budget", type=pos_int, default=2,
+        help="Lifetime cap on policy-engine evictions; a noisy detector "
+        "must not be able to churn the fleet.",
+    )
+    parser.add_argument(
+        "--eviction_cooldown_s", type=float, default=60.0,
+        help="Minimum seconds between two policy-engine evictions.",
+    )
+    parser.add_argument(
+        "--backlog_per_worker", type=float, default=4.0,
+        help="Scale up when queued tasks per alive worker exceed this "
+        "for --backlog_ticks consecutive policy ticks.",
+    )
+    parser.add_argument(
+        "--backlog_ticks", type=pos_int, default=3,
+        help="Consecutive over-threshold ticks before a backlog "
+        "scale-up (hysteresis).",
+    )
+    parser.add_argument(
+        "--data_wait_share", type=float, default=0.6,
+        help="Scale down when the fleet-wide data_wait share of step "
+        "time exceeds this for --data_wait_ticks consecutive ticks "
+        "(input-starved workers add cost, not throughput).",
+    )
+    parser.add_argument(
+        "--data_wait_ticks", type=pos_int, default=3,
+        help="Consecutive over-threshold ticks before a data_wait "
+        "scale-down (hysteresis).",
+    )
+    parser.add_argument(
+        "--scale_step", type=pos_int, default=1,
+        help="Workers added/removed per policy action, rounded to whole "
+        "--workers_per_group slice groups.",
+    )
+    parser.add_argument(
+        "--scale_hold_ticks", type=pos_int, default=2,
+        help="Quiet ticks after any scale action before the next one — "
+        "the fleet must re-converge before the signals mean anything.",
+    )
     parser.add_argument(
         "--wedge_grace_s", type=float, default=20.0,
         help="Seconds a rank may lag a membership-epoch change before its "

@@ -42,6 +42,7 @@ RECOVERY_STARTED = "recovery_started"  # worker loss opened an outage
 RECOVERY_DONE = "recovery_done"        # first post-restore progress
 STEP_PHASES = "step_phases"            # worker phase-time breakdown flush
 STRAGGLER_DETECTED = "straggler_detected"  # master flagged a slow worker
+POLICY_DECISION = "policy_decision"    # master policy engine acted
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -51,7 +52,16 @@ VOCABULARY = frozenset({
     TASK_DISPATCHED, TASK_CLAIMED, TASK_TRAINED, TASK_REPORTED,
     CHECKPOINT_SAVED, CHECKPOINT_RESTORED, SERVING_RELOADED,
     RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
+    POLICY_DECISION,
 })
+
+#: Closed vocabularies for the `action` / `reason` fields every
+#: POLICY_DECISION event must carry (enforced at emit time by
+#: master/policy.py and statically by scripts/check_metric_names.py):
+#: a decision an operator cannot grep for by exact name is a decision
+#: that never reached the dashboards.
+POLICY_ACTIONS = frozenset({"evict", "scale_up", "scale_down"})
+POLICY_REASONS = frozenset({"straggler", "backlog", "data_wait"})
 
 _lock = threading.Lock()
 _fh = None

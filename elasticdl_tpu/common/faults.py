@@ -41,6 +41,11 @@ POINT_WORKER_HEARTBEAT = "worker.heartbeat"
 POINT_POD_WATCH = "pod.watch"
 POINT_RPC_PREDICT = "rpc.predict"
 POINT_SERVING_RELOAD = "serving.reload"
+# Scaling/actuation boundaries (master/policy.py + pod_manager scale
+# paths): apiserver errors mid-scale are part of the chaos surface.
+POINT_POD_CREATE = "pod.create"
+POINT_POD_DELETE = "pod.delete"
+POINT_POLICY_TICK = "policy.tick"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -51,6 +56,9 @@ POINTS = (
     POINT_POD_WATCH,
     POINT_RPC_PREDICT,
     POINT_SERVING_RELOAD,
+    POINT_POD_CREATE,
+    POINT_POD_DELETE,
+    POINT_POLICY_TICK,
 )
 
 ACTIONS = ("raise", "delay", "drop")

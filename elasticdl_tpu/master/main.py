@@ -142,6 +142,7 @@ class Master:
         self.rendezvous_server = None
         self.pod_manager = None
         self.recovery_clock = None
+        self.policy_engine = None
         self._k8s = k8s_client
         if k8s_client is not None:
             from elasticdl_tpu.master.pod_manager import PodManager
@@ -178,6 +179,22 @@ class Master:
             rendezvous_server=self.rendezvous_server,
             recovery_clock=self.recovery_clock,
         )
+        # The actuator that closes the elastic loop (ROADMAP item 4):
+        # constructed whenever the pod machinery exists so snapshot()
+        # and /metrics expose it, but its background thread only runs
+        # with --policy_interval > 0.
+        if self.pod_manager is not None:
+            from elasticdl_tpu.master.policy import (
+                PolicyConfig,
+                PolicyEngine,
+            )
+
+            self.policy_engine = PolicyEngine(
+                self.task_manager,
+                self.pod_manager,
+                PolicyConfig.from_args(args),
+                telemetry_fn=self.servicer.worker_telemetry,
+            )
         self._grpc_server = None
         self._done = threading.Event()
         self._aborted: Optional[str] = None
@@ -278,6 +295,11 @@ class Master:
         actual = self.start_grpc(port)
         if self.pod_manager is not None:
             self.pod_manager.start()
+        if self.policy_engine is not None and self.policy_engine.start():
+            logger.info(
+                "Policy engine ticking every %.1fs",
+                self.policy_engine.config.interval_s,
+            )
         # A restored task journal may already be terminal (all shards of
         # the final epoch done): no worker report will ever drain the
         # queue, so give the finish check one proactive run.
@@ -374,6 +396,8 @@ class Master:
             out["recovery"] = self.recovery_clock.snapshot()
         if self.pod_manager is not None:
             out["pods"] = self.pod_manager.snapshot()
+        if self.policy_engine is not None:
+            out["policy"] = self.policy_engine.snapshot()
         out["workers"] = self.servicer.worker_telemetry()
         # Straggler stats come from the task manager's lease clock, not
         # from worker self-reports — merge them onto the same per-worker
@@ -397,6 +421,8 @@ class Master:
             registries.append(self.recovery_clock.metrics_registry)
         if self.pod_manager is not None:
             registries.append(self.pod_manager.metrics_registry)
+        if self.policy_engine is not None:
+            registries.append(self.policy_engine.metrics_registry)
         return registries
 
     def start_telemetry(self, port: int = 0) -> Optional[int]:
@@ -430,6 +456,8 @@ class Master:
             return None
 
     def stop(self):
+        if self.policy_engine is not None:
+            self.policy_engine.stop()
         if self.pod_manager is not None:
             self.pod_manager.stop()
         if self._grpc_server is not None:

@@ -102,6 +102,14 @@ class PodManager:
             lambda: float(len(self._pod_by_worker)),
             "workers currently in the membership",
         )
+        self._evictions = self.metrics_registry.counter(
+            "master_pod_evictions_total",
+            "straggler pods evicted by the policy engine",
+        )
+        self._launch_failures = self.metrics_registry.counter(
+            "master_pod_launch_failures_total",
+            "worker launches absorbed after apiserver create failures",
+        )
         # Shared resilience policy for apiserver deletes (was a bespoke
         # single-retry loop): NotFound is terminal, anything else gets one
         # backed-off retry before we fall back to the wedge watchdog.
@@ -224,24 +232,148 @@ class PodManager:
 
     # ---- scaling -------------------------------------------------------
 
-    def scale_up(self, n: int = 1):
+    def scale_up(self, n: int = 1) -> int:
+        """Launch n new workers; returns how many actually launched.
+        Apiserver failures are absorbed per-launch — they charge no
+        relaunch chain and leave no phantom membership (_launch_worker),
+        so the policy loop simply retries from real state next tick."""
+        launched = 0
         for _ in range(n):
-            self._launch_worker()
+            if self.stopped:
+                break
+            if self._launch_worker() is not None:
+                launched += 1
+        return launched
 
-    def scale_down(self, n: int = 1):
-        """Remove the newest n workers (graceful: their in-flight tasks are
-        recovered via the DELETED event path)."""
+    def scale_down(self, n: int = 1, prefer=()) -> List[int]:
+        """Remove n workers, rounded DOWN to whole `workers_per_group`
+        slice groups — deleting part of a group would only wedge the
+        survivors in dead ICI collectives.  Victim groups are ranked:
+        groups containing a `prefer` worker (flagged stragglers, idle
+        workers) first, then groups with in-flight vacancies (fewest
+        live members — already below strength, cheapest to retire), then
+        newest.  Graceful: victims' in-flight tasks are recovered via
+        the DELETED event path.  Returns the worker ids removed."""
+        if self.stopped or n <= 0:
+            return []
+        prefer = set(prefer)
+        wpg = self._workers_per_group
         with self._lock:
-            newest = sorted(self._pod_by_worker)[-n:]
-            pods = [self._pod_by_worker[w] for w in newest]
-        for pod in pods:
-            self._k8s.delete_pod(pod)
+            if wpg <= 1:
+                ranked = sorted(
+                    self._pod_by_worker,
+                    key=lambda w: (0 if w in prefer else 1, -w),
+                )
+                victims = ranked[:n]
+            else:
+                groups: Dict[int, List[int]] = {}
+                for wid in self._pod_by_worker:
+                    groups.setdefault(
+                        self._group_of.get(wid, -1), []
+                    ).append(wid)
+                n_groups = n // wpg
+                if n_groups <= 0:
+                    logger.info(
+                        "scale_down(%d) rounds to zero whole groups "
+                        "(workers_per_group=%d); refusing a partial-"
+                        "group delete", n, wpg,
+                    )
+                    return []
+                ranked_groups = sorted(
+                    groups,
+                    key=lambda g: (
+                        0 if any(w in prefer for w in groups[g]) else 1,
+                        len(groups[g]),
+                        -g,
+                    ),
+                )
+                victims = [
+                    w
+                    for g in ranked_groups[:n_groups]
+                    for w in sorted(groups[g])
+                ]
+            pods = [(w, self._pod_by_worker[w]) for w in victims]
+        removed: List[int] = []
+        for w, pod in pods:
+            try:
+                faults.fire(faults.POINT_POD_DELETE)
+                self._delete_policy.call(
+                    lambda: self._k8s.delete_pod(pod),
+                    description="scale_down_delete",
+                )
+            except (resilience.RetryBudgetExhausted,
+                    faults.InjectedFault) as exc:
+                logger.warning(
+                    "scale_down: could not delete %s (%s); it stays in "
+                    "the fleet", pod, exc,
+                )
+                continue
+            except Exception as exc:
+                if not _is_not_found(exc):
+                    raise
+            removed.append(w)
+        return removed
+
+    def evict_worker(self, worker_id: int) -> bool:
+        """Policy-driven eviction of a flagged straggler: delete its pod
+        so the DELETED event relaunches it budget-free (chronic slowness
+        is not a crash) on fresh capacity, its leased tasks recovering
+        via the loss path.  Group-aware: the victim's slice peers are
+        restarted first, exactly as for a real member failure — they
+        would wedge in the dead collective otherwise.  Returns False
+        when the worker is unknown, the manager is stopped, or the
+        apiserver refused the delete."""
+        if self.stopped:
+            return False
+        with self._lock:
+            pod = self._pod_by_worker.get(worker_id)
+            if pod is None:
+                return False
+            group = self._group_of.get(worker_id)
+        try:
+            # Fire before acting so an injected apiserver error aborts
+            # the eviction atomically — no half-restarted group.
+            faults.fire(faults.POINT_POD_DELETE)
+        except faults.InjectedFault as exc:
+            logger.warning(
+                "evict of worker %d aborted by injected apiserver "
+                "error: %s", worker_id, exc,
+            )
+            return False
+        with self._lock:
+            if self._pod_by_worker.get(worker_id) != pod:
+                return False  # lost/retired while we weren't holding
+            self._group_restart_pods.add(pod)
+        self._restart_group_peers(group, lost_worker=worker_id)
+        try:
+            self._delete_policy.call(
+                lambda: self._k8s.delete_pod(pod),
+                description="evict_pod",
+            )
+        except resilience.RetryBudgetExhausted as exc:
+            logger.warning(
+                "evict: could not delete %s (%s); straggler stays until "
+                "the next policy tick", pod, exc,
+            )
+            with self._lock:
+                self._group_restart_pods.discard(pod)
+            return False
+        except Exception as exc:
+            if not _is_not_found(exc):
+                raise
+            # Already gone: its own FAILED/DELETED event recovers it.
+            with self._lock:
+                self._group_restart_pods.discard(pod)
+        self._evictions.inc()
+        return True
 
     def _launch_worker(
         self, worker_id: Optional[int] = None,
         group: Optional[int] = None,
-    ) -> int:
+    ) -> Optional[int]:
         with self._lock:
+            if self.stopped:
+                return None
             if worker_id is None:
                 worker_id = self._next_worker_id
                 self._next_worker_id += 1
@@ -265,7 +397,25 @@ class PodManager:
             labels={"elasticdl-group": str(group)},
         )
         logger.info("Launching %s", pod_name)
-        self._k8s.create_pod(spec)
+        try:
+            faults.fire(faults.POINT_POD_CREATE)
+            self._k8s.create_pod(spec)
+        except Exception as exc:
+            # Absorbed, not propagated: the pod never existed, so no
+            # DELETED event will ever clean it up — unregister the
+            # phantom membership here and charge NO relaunch chain.
+            logger.warning("Launch of %s failed: %s", pod_name, exc)
+            self._launch_failures.inc()
+            with self._lock:
+                self._pod_by_worker.pop(worker_id, None)
+                self._worker_by_pod.pop(pod_name, None)
+                self._group_of.pop(worker_id, None)
+                self._relaunch_count.pop(worker_id, None)
+                if self._rendezvous is not None:
+                    self._rendezvous.set_expected(
+                        len(self._pod_by_worker)
+                    )
+            return None
         return worker_id
 
     def _register_worker_locked(self, worker_id: int) -> str:
@@ -455,4 +605,6 @@ class PodManager:
                 "alive": len(self._pod_by_worker),
                 "losses_seen": int(self._losses_seen.value()),
                 "relaunches": int(self._relaunches.value()),
+                "evictions": int(self._evictions.value()),
+                "launch_failures": int(self._launch_failures.value()),
             }

@@ -1,0 +1,414 @@
+"""Policy engine: the actuator that closes the elastic control loop.
+
+PR 4+5 built the sensors — straggler flags with dwell clocks
+(task_manager.straggler_snapshot), queue depth (task_manager.snapshot),
+per-phase step breakdowns (servicer.worker_telemetry), and the recovery
+clock.  This module is the consumer the paper's headline feature needs: a
+periodic loop in the master that *acts* on a changing fleet (PAPER.md
+§0.3) instead of only charting it.
+
+Per tick, in priority order, at most ONE action:
+
+1. **Evict** the lowest-id flagged straggler whose flag has dwelled past
+   `straggler_dwell_s` — chronic slowness is usually placement (a noisy
+   neighbour, a degraded host), and a relaunch on fresh capacity is the
+   only remediation a master has.  Bounded by a lifetime
+   `eviction_budget` and an `eviction_cooldown_s` between evictions so a
+   noisy detector cannot churn the fleet.  Group-aware via
+   PodManager.evict_worker: on TPU the victim's whole slice restarts.
+2. **Scale up** by `scale_step` (whole groups when workers_per_group>1)
+   when the task backlog per worker has exceeded `backlog_per_worker`
+   for `backlog_ticks` consecutive ticks and the fleet is below
+   `max_workers`.
+3. **Scale down** (whole groups, straggler-preferring victims) when the
+   fleet-wide `data_wait` phase share — the fraction of worker step time
+   spent blocked on the input pipeline, computed as a windowed delta of
+   the cumulative phase clocks between ticks — has exceeded
+   `data_wait_share` for `data_wait_ticks` consecutive ticks and the
+   fleet is above `min_workers`.  Input-starved workers add cost, not
+   throughput.
+
+Hysteresis: the consecutive-tick streaks gate entry, and every scale
+action arms `scale_hold_ticks` quiet ticks before the next one — the
+fleet must re-converge (rendezvous epoch, recompile, queue drain) before
+the signals mean anything again.
+
+Determinism is load-bearing: the loop takes an injectable `clock`, fires
+the `policy.tick` fault point first thing (an injected raise models a
+wedged control plane and skips the tick), iterates snapshots in sorted
+order, and records every decision both as a `policy_decision` span event
+(action/reason from the closed vocabulary in common/events.py, plus the
+inputs that justified it) and in an in-memory list whose projection is
+byte-stable across same-seed chaos runs.  `--policy_interval 0` (the
+default) disables the background thread entirely; tests drive `tick()`
+by hand under a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common.log_utils import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class PolicyConfig:
+    """Thresholds and bounds for one policy loop (docs/ROBUSTNESS.md
+    "Policy engine" maps each field to its --flag)."""
+
+    min_workers: int = 1
+    max_workers: int = 1
+    interval_s: float = 0.0          # 0 = loop disabled
+    workers_per_group: int = 1
+    straggler_dwell_s: float = 30.0  # flag must persist this long
+    eviction_budget: int = 2         # lifetime cap on evictions
+    eviction_cooldown_s: float = 60.0
+    backlog_per_worker: float = 4.0  # queued tasks per worker
+    backlog_ticks: int = 3           # consecutive ticks above threshold
+    data_wait_share: float = 0.6     # fleet data_wait fraction of step
+    data_wait_ticks: int = 3
+    scale_step: int = 1              # workers per action (group-aligned)
+    scale_hold_ticks: int = 2        # quiet ticks after any scale action
+
+    @classmethod
+    def from_args(cls, args) -> "PolicyConfig":
+        num_workers = getattr(args, "num_workers", 1)
+        max_workers = getattr(args, "max_workers", 0) or num_workers
+        return cls(
+            min_workers=getattr(args, "min_workers", 1),
+            max_workers=max(max_workers, getattr(args, "min_workers", 1)),
+            interval_s=getattr(args, "policy_interval", 0.0),
+            workers_per_group=max(
+                1, getattr(args, "workers_per_group", 1)
+            ),
+            straggler_dwell_s=getattr(args, "straggler_dwell_s", 30.0),
+            eviction_budget=getattr(args, "eviction_budget", 2),
+            eviction_cooldown_s=getattr(
+                args, "eviction_cooldown_s", 60.0
+            ),
+            backlog_per_worker=getattr(args, "backlog_per_worker", 4.0),
+            backlog_ticks=getattr(args, "backlog_ticks", 3),
+            data_wait_share=getattr(args, "data_wait_share", 0.6),
+            data_wait_ticks=getattr(args, "data_wait_ticks", 3),
+            scale_step=getattr(args, "scale_step", 1),
+            scale_hold_ticks=getattr(args, "scale_hold_ticks", 2),
+        )
+
+
+class PolicyEngine:
+    """Periodic evict/autoscale loop over the master's own components.
+
+    `telemetry_fn` returns the servicer's worker_telemetry() dict (the
+    cumulative `phase_<name>_ms` clocks piggybacked on worker reports);
+    `clock` is wall time in production and a fake in tests.
+    """
+
+    def __init__(
+        self,
+        task_manager,
+        pod_manager,
+        config: PolicyConfig,
+        telemetry_fn: Optional[Callable[[], dict]] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._tm = task_manager
+        self._pods = pod_manager
+        self.config = config
+        self._telemetry_fn = telemetry_fn or (lambda: {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+        self._tick_count = 0
+        self._backlog_streak = 0
+        self._data_wait_streak = 0
+        self._hold_ticks = 0
+        self._evictions_used = 0
+        self._last_eviction_at: Optional[float] = None
+        # last-tick cumulative fleet phase clocks (wait_ms, total_ms)
+        self._last_phase = (0.0, 0.0)
+        self._last_backlog_ratio = 0.0
+        self._last_data_wait_ratio = 0.0
+        #: decisions in tick order; each entry is clock-free (tick index,
+        #: action, reason, integer/rounded inputs) so same-seed chaos
+        #: runs can byte-compare the whole list.
+        self.decisions: List[dict] = []
+
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self._ticks = self.metrics_registry.counter(
+            "master_policy_ticks_total",
+            "policy loop ticks executed",
+        )
+        self._skipped = self.metrics_registry.counter(
+            "master_policy_skipped_ticks_total",
+            "ticks aborted by an injected policy.tick fault",
+        )
+        self._decisions_total = self.metrics_registry.counter(
+            "master_policy_decisions_total",
+            "actions taken by the policy loop",
+            labelnames=("action", "reason"),
+        )
+        self.metrics_registry.gauge_fn(
+            "master_policy_eviction_budget_count",
+            lambda: float(
+                max(0, self.config.eviction_budget - self._evictions_used)
+            ),
+            "evictions remaining in the lifetime budget",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_policy_backlog_per_worker_ratio",
+            lambda: self._last_backlog_ratio,
+            "queued tasks per alive worker at the last tick",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_policy_data_wait_ratio",
+            lambda: self._last_data_wait_ratio,
+            "fleet data_wait share of step time over the last tick window",
+        )
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> bool:
+        """Start the background loop; no-op (returns False) when
+        interval_s <= 0 — the documented off switch."""
+        if self.config.interval_s <= 0 or self._thread is not None:
+            return False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="policy-engine", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def stop(self):
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # The policy loop must never take down the job brain.
+                logger.exception("policy tick failed")
+
+    # ---- the loop body -------------------------------------------------
+
+    def tick(self) -> Optional[dict]:
+        """One control decision; returns the decision record or None.
+        Serialized under a lock so a background tick and a test-driven
+        tick cannot interleave their read-decide-act sequences."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Optional[dict]:
+        self._tick_count += 1
+        self._ticks.inc()
+        try:
+            faults.fire(faults.POINT_POLICY_TICK)
+        except faults.InjectedFault as exc:
+            # A wedged control plane skips the tick; streaks and holds
+            # freeze rather than decay — the next healthy tick resumes.
+            self._skipped.inc()
+            logger.warning("policy tick %d skipped: %s", self._tick_count, exc)
+            return None
+
+        alive = self._pods.alive_workers()
+        decision = self._maybe_evict(alive)
+        if decision is None:
+            decision = self._maybe_scale(alive)
+        return decision
+
+    # ---- eviction ------------------------------------------------------
+
+    def _maybe_evict(self, alive: List[int]) -> Optional[dict]:
+        cfg = self.config
+        if self._evictions_used >= cfg.eviction_budget:
+            return None
+        now = self._clock()
+        if (
+            self._last_eviction_at is not None
+            and now - self._last_eviction_at < cfg.eviction_cooldown_s
+        ):
+            return None
+        # Never evict below min_workers: the group restart brings the
+        # victim back, but transiently the fleet dips by one group.
+        if len(alive) < max(cfg.min_workers, 1):
+            return None
+        snap = self._tm.straggler_snapshot()
+        for wid in sorted(snap):
+            stats = snap[wid]
+            if not stats.get("straggler"):
+                continue
+            if stats.get("flagged_for_s", 0.0) < cfg.straggler_dwell_s:
+                continue
+            if wid not in alive:
+                continue
+            if not self._pods.evict_worker(wid):
+                continue
+            self._evictions_used += 1
+            self._last_eviction_at = now
+            record = self._record(
+                "evict", "straggler",
+                worker_id=wid,
+                flagged_for_s=round(stats["flagged_for_s"], 3),
+                mean_task_s=round(stats.get("mean_task_s", 0.0), 3),
+                budget_left=cfg.eviction_budget - self._evictions_used,
+            )
+            events.emit(
+                events.POLICY_DECISION, action="evict", reason="straggler",
+                worker_id=wid, tick=self._tick_count,
+                flagged_for_s=record["flagged_for_s"],
+            )
+            return record
+        return None
+
+    # ---- autoscaling ---------------------------------------------------
+
+    def _signals(self, alive: List[int]) -> None:
+        """Refresh the two scaling signals and their hysteresis streaks."""
+        cfg = self.config
+        todo = self._tm.snapshot().get("todo", 0)
+        self._last_backlog_ratio = todo / max(1, len(alive))
+        if self._last_backlog_ratio > cfg.backlog_per_worker:
+            self._backlog_streak += 1
+        else:
+            self._backlog_streak = 0
+
+        wait_ms = total_ms = 0.0
+        for entry in self._telemetry_fn().values():
+            for key, value in entry.items():
+                if not key.startswith("phase_") or not key.endswith("_ms"):
+                    continue
+                try:
+                    value = float(value)
+                except (TypeError, ValueError):
+                    continue
+                total_ms += value
+                if key == "phase_data_wait_ms":
+                    wait_ms += value
+        prev_wait, prev_total = self._last_phase
+        self._last_phase = (wait_ms, total_ms)
+        delta_total = total_ms - prev_total
+        delta_wait = wait_ms - prev_wait
+        if delta_total > 0 and delta_wait >= 0:
+            self._last_data_wait_ratio = min(
+                1.0, delta_wait / delta_total
+            )
+        else:
+            # No step progress this window (or a counter reset): no
+            # signal — starving the fleet on stale data would be worse.
+            self._last_data_wait_ratio = 0.0
+        if self._last_data_wait_ratio > cfg.data_wait_share:
+            self._data_wait_streak += 1
+        else:
+            self._data_wait_streak = 0
+
+    def _aligned_step(self, room: int) -> int:
+        """Per-tick step, aligned to whole groups and capped by room."""
+        cfg = self.config
+        wpg = cfg.workers_per_group
+        step = min(max(1, cfg.scale_step), max(0, room))
+        if wpg > 1:
+            # whole slices only: request at least one group, never more
+            # than fit in the room
+            step = min(
+                wpg * max(1, cfg.scale_step // wpg),
+                (room // wpg) * wpg,
+            )
+        return step
+
+    def _maybe_scale(self, alive: List[int]) -> Optional[dict]:
+        cfg = self.config
+        self._signals(alive)
+        if self._hold_ticks > 0:
+            self._hold_ticks -= 1
+            return None
+
+        if self._backlog_streak >= cfg.backlog_ticks:
+            step = self._aligned_step(cfg.max_workers - len(alive))
+            if step > 0:
+                launched = self._pods.scale_up(step)
+                self._hold_ticks = cfg.scale_hold_ticks
+                self._backlog_streak = 0
+                self._data_wait_streak = 0
+                record = self._record(
+                    "scale_up", "backlog",
+                    backlog_per_worker=round(self._last_backlog_ratio, 3),
+                    alive=len(alive), requested=step, launched=launched,
+                )
+                events.emit(
+                    events.POLICY_DECISION,
+                    action="scale_up", reason="backlog",
+                    tick=self._tick_count, requested=step,
+                    launched=launched,
+                    backlog_per_worker=record["backlog_per_worker"],
+                )
+                return record
+
+        if self._data_wait_streak >= cfg.data_wait_ticks:
+            step = self._aligned_step(len(alive) - cfg.min_workers)
+            if step > 0:
+                flagged = sorted(
+                    wid
+                    for wid, s in self._tm.straggler_snapshot().items()
+                    if s.get("straggler")
+                )
+                removed = self._pods.scale_down(step, prefer=flagged)
+                if removed:
+                    self._hold_ticks = cfg.scale_hold_ticks
+                    self._backlog_streak = 0
+                    self._data_wait_streak = 0
+                    record = self._record(
+                        "scale_down", "data_wait",
+                        data_wait_ratio=round(
+                            self._last_data_wait_ratio, 3
+                        ),
+                        alive=len(alive), removed=sorted(removed),
+                    )
+                    events.emit(
+                        events.POLICY_DECISION,
+                        action="scale_down", reason="data_wait",
+                        tick=self._tick_count, removed=sorted(removed),
+                        data_wait_ratio=record["data_wait_ratio"],
+                    )
+                    return record
+        return None
+
+    # ---- bookkeeping ---------------------------------------------------
+
+    def _record(self, action: str, reason: str, **inputs) -> dict:
+        assert action in events.POLICY_ACTIONS, action
+        assert reason in events.POLICY_REASONS, reason
+        self._decisions_total.labels(action=action, reason=reason).inc()
+        record = {"tick": self._tick_count, "action": action,
+                  "reason": reason}
+        record.update(inputs)
+        self.decisions.append(record)
+        logger.info("policy decision: %s", record)
+        return record
+
+    def snapshot(self) -> dict:
+        return {
+            "ticks": self._tick_count,
+            "evictions_used": self._evictions_used,
+            "eviction_budget": self.config.eviction_budget,
+            "backlog_streak": self._backlog_streak,
+            "data_wait_streak": self._data_wait_streak,
+            "hold_ticks": self._hold_ticks,
+            "backlog_per_worker": round(self._last_backlog_ratio, 3),
+            "data_wait_ratio": round(self._last_data_wait_ratio, 3),
+            "decisions": list(self.decisions),
+            "interval_s": self.config.interval_s,
+        }

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from elasticdl_tpu.common import events
 from elasticdl_tpu.common import metrics as metrics_lib
@@ -28,7 +28,11 @@ logger = get_logger(__name__)
 
 
 class RecoveryClock:
-    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None):
+    def __init__(self, registry: Optional[metrics_lib.MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.time):
+        # injectable for fake-clock policy chaos tests (task_manager and
+        # policy take the same parameter)
+        self._clock = clock
         self._lock = threading.Lock()
         self._pending_since: Optional[float] = None
         self.history: List[float] = []
@@ -65,7 +69,7 @@ class RecoveryClock:
             self._losses.inc()
             opened = self._pending_since is None
             if opened:
-                self._pending_since = time.time()
+                self._pending_since = self._clock()
         if opened:
             events.emit(events.RECOVERY_STARTED)
 
@@ -75,7 +79,7 @@ class RecoveryClock:
         with self._lock:
             if self._pending_since is None:
                 return None
-            elapsed = time.time() - self._pending_since
+            elapsed = self._clock() - self._pending_since
             self._pending_since = None
             self.history.append(elapsed)
             self._recoveries.inc()

@@ -172,8 +172,13 @@ class TaskManager:
         restore_cutoff_step: Optional[int] = None,
         straggler_multiple: float = 3.0,
         straggler_min_tasks: int = 3,
+        clock: Callable[[], float] = time.time,
     ):
         self._lock = threading.Lock()
+        # Injectable clock: every lease/duration/dwell timestamp reads it,
+        # so the policy-engine chaos tests drive straggler dwell with a
+        # fake clock and decisions replay deterministically.
+        self._clock = clock
         self._training_shards = list(training_shards or [])
         self._evaluation_shards = list(evaluation_shards or [])
         self._prediction_shards = list(prediction_shards or [])
@@ -233,6 +238,11 @@ class TaskManager:
         self._straggler_min_tasks = int(straggler_min_tasks)
         self._worker_task_s: Dict[int, deque] = {}
         self._stragglers: set = set()
+        # worker_id -> clock() when the current flag was first raised.
+        # Dwell accounting for the policy engine: eviction requires a flag
+        # to PERSIST (--straggler_dwell_s), so one noisy window cannot
+        # cost a pod.  Cleared when the flag clears or the worker dies.
+        self._straggler_since: Dict[int, float] = {}
         self.counters.registry.gauge_fn(
             "master_straggler_workers_count",
             lambda: float(len(self._stragglers)),
@@ -495,7 +505,7 @@ class TaskManager:
                 # worker already declared dead.
                 return None
             task = None
-            now = time.time()
+            now = self._clock()
             if task_type is None:
                 for i, cand in enumerate(self._todo):
                     if self._transient_hold.get(cand.task_id, 0) <= now:
@@ -527,7 +537,8 @@ class TaskManager:
                     task = self._todo.popleft() if self._todo else None
             if task is not None:
                 self._doing[task.task_id] = _DoingEntry(
-                    worker_id=worker_id, task=task, lease_start=time.time()
+                    worker_id=worker_id, task=task,
+                    lease_start=self._clock(),
                 )
             return task
 
@@ -560,7 +571,7 @@ class TaskManager:
                 and entry.worker_id >= 0
             ):
                 newly_flagged = self._observe_task_duration_locked(
-                    entry.worker_id, time.time() - entry.lease_start
+                    entry.worker_id, self._clock() - entry.lease_start
                 )
             if success:
                 self.counters.finished += 1
@@ -582,7 +593,7 @@ class TaskManager:
                     self._transient_count.get(task_id, 0) + 1
                 )
                 self._transient_hold[task_id] = (
-                    time.time() + self.TRANSIENT_HOLD_S
+                    self._clock() + self.TRANSIENT_HOLD_S
                 )
                 self._todo.append(task)
                 logger.info(
@@ -649,6 +660,7 @@ class TaskManager:
         # A one-worker fleet has no peer to be slower than.
         if len(means) < 2:
             self._stragglers.clear()
+            self._straggler_since.clear()
             return []
         # Lower median: in a small even fleet the interpolated median is
         # dragged up by the straggler's own mean (2 workers: the baseline
@@ -659,6 +671,7 @@ class TaskManager:
         median = ordered[(len(ordered) - 1) // 2]
         if median <= 0:
             self._stragglers.clear()
+            self._straggler_since.clear()
             return []
         flagged = {
             wid for wid, mean in means.items()
@@ -666,17 +679,33 @@ class TaskManager:
         }
         newly = flagged - self._stragglers
         self._stragglers = flagged
+        # Dwell clock: stamp first-flag time for new flags, drop cleared
+        # ones — a flag that bounces restarts its dwell from zero.
+        now = self._clock()
+        for wid in newly:
+            self._straggler_since[wid] = now
+        for wid in list(self._straggler_since):
+            if wid not in flagged:
+                del self._straggler_since[wid]
         return [(wid, means[wid], median) for wid in sorted(newly)]
 
     def straggler_snapshot(self) -> Dict[int, dict]:
         """worker_id -> rolling task-duration stats + straggler flag,
         merged into Master.snapshot()['workers'] for /varz and `top`."""
         with self._lock:
+            now = self._clock()
             return {
                 wid: {
                     "task_count": len(window),
                     "mean_task_s": round(sum(window) / len(window), 6),
                     "straggler": wid in self._stragglers,
+                    # seconds the current flag has persisted (0 when not
+                    # flagged) — the policy engine's dwell input
+                    "flagged_for_s": (
+                        round(now - self._straggler_since[wid], 6)
+                        if wid in self._straggler_since
+                        else 0.0
+                    ),
                 }
                 for wid, window in self._worker_task_s.items()
                 if window
@@ -691,6 +720,7 @@ class TaskManager:
             # median (or linger as a phantom straggler flag).
             self._worker_task_s.pop(worker_id, None)
             self._stragglers.discard(worker_id)
+            self._straggler_since.pop(worker_id, None)
             dead = [
                 tid for tid, e in self._doing.items() if e.worker_id == worker_id
             ]
@@ -706,7 +736,7 @@ class TaskManager:
 
     def reap_expired_tasks(self, now: Optional[float] = None) -> int:
         """Re-queue tasks whose lease exceeded the timeout."""
-        now = time.time() if now is None else now
+        now = self._clock() if now is None else now
         with self._lock:
             expired = [
                 tid

@@ -2,7 +2,7 @@
 """Lint: registry metrics use literal, `subsystem_name_unit` names, and
 instrumented modules do not grow private counter bookkeeping back.
 
-Three rules over elasticdl_tpu/:
+Four rules over elasticdl_tpu/:
 
 1. **Name discipline.**  Every metric-creation call
    (`*.counter(...)`, `*.gauge(...)`, `*.gauge_fn(...)`,
@@ -30,6 +30,14 @@ Three rules over elasticdl_tpu/:
    off every consumer.  common/events.py itself (the definitions) is
    exempt.
 
+4. **Policy-decision fields.**  Every
+   `emit(events.POLICY_DECISION, ...)` must carry `action=` and
+   `reason=` keyword arguments as STRING LITERALS drawn from the closed
+   POLICY_ACTIONS / POLICY_REASONS vocabularies in common/events.py — a
+   policy decision an operator cannot grep for by exact name never
+   reached the dashboards, and a computed value defeats both this lint
+   and the vocabulary.
+
 Exit status: 0 when clean, 1 with one `path:line: message` per finding.
 """
 
@@ -43,6 +51,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from elasticdl_tpu.common.events import (  # noqa: E402
+    POLICY_ACTIONS,
+    POLICY_REASONS,
+)
 from elasticdl_tpu.common.metrics import validate_metric_name  # noqa: E402
 
 CREATION_METHODS = {"counter", "gauge", "gauge_fn", "histogram"}
@@ -129,6 +141,50 @@ def find_stringly_events(tree: ast.AST):
             )
 
 
+def find_unlabeled_policy_decisions(tree: ast.AST):
+    """Yield (lineno, message) for `emit(events.POLICY_DECISION, ...)`
+    calls missing `action=`/`reason=` string literals from the closed
+    vocabularies in common/events.py."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Attribute)
+                and first.attr == "POLICY_DECISION"):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        for field, vocab in (
+            ("action", POLICY_ACTIONS),
+            ("reason", POLICY_REASONS),
+        ):
+            value = kwargs.get(field)
+            if value is None:
+                yield (
+                    node.lineno,
+                    "emit(events.POLICY_DECISION, ...) must carry "
+                    f"{field}= — a decision without it cannot be "
+                    "grepped off the event stream",
+                )
+            elif not (isinstance(value, ast.Constant)
+                      and isinstance(value.value, str)):
+                yield (
+                    node.lineno,
+                    f"emit(events.POLICY_DECISION, ...): {field}= must "
+                    "be a string literal from the closed vocabulary in "
+                    "common/events.py, not a computed value",
+                )
+            elif value.value not in vocab:
+                yield (
+                    node.lineno,
+                    f"emit(events.POLICY_DECISION, ...): "
+                    f"{field}={value.value!r} is not in the closed "
+                    f"vocabulary {sorted(vocab)}",
+                )
+
+
 def find_shadow_counters(tree: ast.AST):
     """Yield (lineno, message) for private tallies in instrumented
     modules: `self.x = 0` counter-shaped attrs and collections.Counter
@@ -177,6 +233,7 @@ def check_file(path: str, rel: str):
     findings = list(find_bad_metric_names(tree))
     if rel != os.path.join("elasticdl_tpu", "common", "events.py"):
         findings.extend(find_stringly_events(tree))
+    findings.extend(find_unlabeled_policy_decisions(tree))
     if rel in INSTRUMENTED:
         findings.extend(
             (lineno, message)

@@ -68,6 +68,52 @@ def test_ignores_non_counter_state():
                                 .replace("unique_cap", "poll_interval"))
 
 
+def _policy_findings(source):
+    return list(
+        _load().find_unlabeled_policy_decisions(ast.parse(source))
+    )
+
+
+def test_detects_policy_decision_missing_fields():
+    # no action/reason at all: two findings
+    found = _policy_findings(
+        "events.emit(events.POLICY_DECISION, worker_id=3)\n"
+    )
+    assert len(found) == 2, found
+    # reason present, action missing
+    assert _policy_findings(
+        "events.emit(events.POLICY_DECISION, reason='backlog')\n"
+    )
+
+
+def test_detects_policy_decision_computed_or_unknown_values():
+    # computed value defeats the closed vocabulary
+    assert _policy_findings(
+        "events.emit(events.POLICY_DECISION, action=act, "
+        "reason='backlog')\n"
+    )
+    # literal but outside the vocabulary
+    assert _policy_findings(
+        "events.emit(events.POLICY_DECISION, action='reboot', "
+        "reason='backlog')\n"
+    )
+    assert _policy_findings(
+        "events.emit(events.POLICY_DECISION, action='evict', "
+        "reason='vibes')\n"
+    )
+
+
+def test_accepts_well_formed_policy_decisions():
+    assert not _policy_findings(
+        "events.emit(events.POLICY_DECISION, action='evict', "
+        "reason='straggler', worker_id=2, tick=7)\n"
+    )
+    # other events are not subject to rule 4
+    assert not _policy_findings(
+        "events.emit(events.TASK_REPORTED, task_id=1)\n"
+    )
+
+
 def test_repo_tree_is_clean():
     proc = subprocess.run(
         [sys.executable, SCRIPT],

@@ -1,0 +1,170 @@
+"""PodManager scaling edge cases (ISSUE 6 satellites): group-aware
+scale_down rounding and victim preference, scale_down below an in-flight
+group vacancy, scale_up after an exhausted relaunch chain, absorbed
+launch failures charging no chain, and stop() racing a scale tick."""
+
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.k8s_client import FakeK8sClient
+from elasticdl_tpu.master.pod_manager import PodManager
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_registry():
+    yield
+    faults.uninstall()
+
+
+class StubTaskManager:
+    def __init__(self):
+        self.recovered = []
+
+    def recover_tasks(self, worker_id):
+        self.recovered.append(worker_id)
+        return 0
+
+
+def make_manager(num_workers, wpg=1, budget=3, on_abort=None):
+    k8s = FakeK8sClient()
+    tm = StubTaskManager()
+    manager = PodManager(
+        k8s,
+        task_manager=tm,
+        job_name="scaletest",
+        num_workers=num_workers,
+        relaunch_on_worker_failure=budget,
+        workers_per_group=wpg,
+        on_job_abort=on_abort,
+    )
+    manager.start()
+    return manager, k8s, tm
+
+
+def test_scale_down_refuses_partial_group():
+    manager, k8s, _ = make_manager(6, wpg=2)
+    assert manager.scale_down(1) == []
+    assert len(manager.alive_workers()) == 6
+    assert k8s.delete_calls == []
+
+
+def test_scale_down_removes_whole_newest_group():
+    manager, _, _ = make_manager(6, wpg=2)
+    removed = manager.scale_down(2)
+    # one whole group, and the newest one
+    assert removed == [4, 5]
+    assert manager.alive_workers() == [0, 1, 2, 3]
+    # 3 requested rounds down to one group again
+    assert manager.scale_down(3) == [2, 3]
+    assert manager.alive_workers() == [0, 1]
+
+
+def test_scale_down_prefers_group_with_flagged_worker():
+    manager, _, _ = make_manager(6, wpg=2)
+    # worker 2 lives in group 1 ({2, 3}): its whole group goes first
+    removed = manager.scale_down(2, prefer=[2])
+    assert removed == [2, 3]
+    assert manager.alive_workers() == [0, 1, 4, 5]
+
+
+def test_scale_down_below_inflight_group_vacancy():
+    """A group left under strength by an absorbed relaunch failure is
+    the preferred scale_down victim, and removing it removes fewer
+    workers than the nominal group size."""
+    manager, k8s, _ = make_manager(4, wpg=2)
+    # the registry is installed after start(), so hit 0 is the first
+    # post-kill launch: the group-restart relaunch of worker 0's peer
+    faults.install(faults.FaultRegistry(
+        [faults.FaultSpec(faults.POINT_POD_CREATE, 0, "raise")]
+    ))
+    k8s.emit("scaletest-worker-0", PodStatus.FAILED, exit_code=1)
+    # group 0 re-formed short one member: peer relaunch failed
+    assert manager.snapshot()["launch_failures"] == 1
+    alive = manager.alive_workers()
+    assert len(alive) == 3
+    groups = {}
+    for wid in alive:
+        groups.setdefault(manager._group_of[wid], []).append(wid)
+    (short_group,) = [g for g, ws in groups.items() if len(ws) == 1]
+    removed = manager.scale_down(2)
+    assert removed == groups[short_group]
+    assert len(manager.alive_workers()) == 2
+
+
+def test_scale_up_after_exhausted_relaunch_chain():
+    aborts = []
+    manager, k8s, _ = make_manager(
+        1, budget=1, on_abort=aborts.append
+    )
+    k8s.emit("scaletest-worker-0", PodStatus.FAILED, exit_code=1)
+    assert manager.alive_workers() == [1]
+    k8s.emit("scaletest-worker-1", PodStatus.FAILED, exit_code=1)
+    # chain exhausted with nobody left: abort fired, nothing alive
+    assert manager.alive_workers() == []
+    assert len(aborts) == 1
+    # scale_up opens FRESH chains: new workers launch and still get
+    # their own relaunch budget
+    assert manager.scale_up(2) == 2
+    assert manager.alive_workers() == [2, 3]
+    k8s.emit("scaletest-worker-2", PodStatus.FAILED, exit_code=1)
+    assert manager.alive_workers() == [3, 4]
+    assert len(aborts) == 1
+
+
+def test_scale_up_launch_failure_charges_no_chain():
+    manager, k8s, _ = make_manager(2)
+    faults.install(faults.FaultRegistry(
+        [faults.FaultSpec(faults.POINT_POD_CREATE, 0, "raise")]
+    ))
+    assert manager.scale_up(1) == 0
+    # no phantom membership, no chain entry for the stillborn worker
+    assert manager.alive_workers() == [0, 1]
+    assert manager.snapshot()["launch_failures"] == 1
+    assert manager._relaunch_count == {}
+    # the next attempt (hit 1, unscheduled) succeeds under a fresh id
+    assert manager.scale_up(1) == 1
+    assert manager.alive_workers() == [0, 1, 3]
+    assert len(k8s.pods) == 3
+
+
+def test_stop_blocks_scaling_calls():
+    manager, k8s, _ = make_manager(2)
+    manager.stop()
+    creates_before = len(k8s.create_calls)
+    assert manager.scale_up(3) == 0
+    assert manager.scale_down(1) == []
+    assert manager.evict_worker(0) is False
+    assert len(k8s.create_calls) == creates_before
+
+
+def test_stop_racing_scale_tick():
+    """stop() landing mid-scale_up: the in-flight launch is torn down by
+    the stop sweep and the remaining launches are suppressed."""
+
+    class StopOnCreate(FakeK8sClient):
+        manager = None
+        fired = False
+
+        def create_pod(self, spec):
+            super().create_pod(spec)
+            if not self.fired and spec.worker_id >= 2:
+                self.fired = True
+                self.manager.stop()
+
+    k8s = StopOnCreate()
+    manager = PodManager(
+        k8s,
+        task_manager=StubTaskManager(),
+        job_name="scaletest",
+        num_workers=2,
+        workers_per_group=1,
+    )
+    k8s.manager = manager
+    manager.start()
+    launched = manager.scale_up(5)
+    # worker 2 launched, then stop() swept it; workers 3..6 never start
+    assert launched == 1
+    assert manager.alive_workers() == []
+    assert manager.stopped
+    assert len(k8s.create_calls) == 3
