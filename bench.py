@@ -814,6 +814,206 @@ def bench_serving(
     }
 
 
+def bench_serving_fleet(
+    clients: int = 4,
+    requests_per_client: int = 50,
+    replicas: int = 3,
+    model_def: str = "mnist.mnist_functional_api.custom_model",
+):
+    """Fleet bench (`python bench.py --serving-fleet`): offered load
+    against N in-process serving replicas behind the FleetRouter while
+    the ServingFleetManager absorbs one mid-run replica kill and
+    sequences one rolling hot-reload (docs/SERVING.md "Fleet").  Reports
+    client-observed p50/p99, the failed-request count (the failover
+    guarantee says it must be 0), and the max observed cross-replica
+    model_step skew vs the SLO."""
+    import tempfile
+    import threading
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common.constants import PodStatus
+    from elasticdl_tpu.common.k8s_client import FakeK8sClient
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.common.resilience import RetryPolicy
+    from elasticdl_tpu.common.save_utils import CheckpointSaver
+    from elasticdl_tpu.master.serving_fleet import (
+        ServingFleetConfig,
+        ServingFleetManager,
+    )
+    from elasticdl_tpu.proto import serving_pb2 as spb
+    from elasticdl_tpu.proto.service import (
+        FleetRouter,
+        InProcessServingClient,
+    )
+    from elasticdl_tpu.serving.batcher import DynamicBatcher
+    from elasticdl_tpu.serving.engine import ServingEngine
+    from elasticdl_tpu.serving.reloader import CheckpointReloader
+    from elasticdl_tpu.serving.server import (
+        ServingServicer,
+        make_predict_request,
+    )
+    from elasticdl_tpu.worker.trainer import TrainState
+
+    class _Killable:
+        """In-process client whose kill switch stands in for a dead pod."""
+
+        def __init__(self, servicer):
+            self._inner = InProcessServingClient(servicer)
+            self.killed = False
+
+        def predict(self, request, timeout=None):
+            if self.killed:
+                raise ConnectionError("replica killed")
+            return self._inner.predict(request, timeout=timeout)
+
+        def health(self, request, timeout=None):
+            if self.killed:
+                raise ConnectionError("replica killed")
+            return self._inner.health(request, timeout=timeout)
+
+    spec = get_model_spec(_ZOO, model_def)
+    sample = np.random.RandomState(0).rand(2, 784).astype(np.float32)
+    variables = dict(spec.model.init(jax.random.PRNGKey(0), sample))
+    params = {"params": variables.pop("params")}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        saver = CheckpointSaver(tmp, async_save=False)
+
+        def save_step(step, scale):
+            scaled = jax.tree.map(lambda a: a * scale, params)
+            saver.save(TrainState(
+                step=jnp.asarray(step, jnp.int32), params=scaled,
+                opt_state=spec.optimizer.init(scaled),
+                model_state=variables,
+            ), force=True)
+            saver.wait_until_finished()
+
+        save_step(1, 1.0)
+        latest = [1]
+        fleet = {}
+        for rid in range(replicas):
+            engine = ServingEngine.from_checkpoint(
+                tmp, spec, sample, buckets=(2, 8)
+            )
+            batcher = DynamicBatcher(engine, max_latency_s=0.002)
+            reloader = CheckpointReloader(
+                engine, tmp, poll_interval_s=3600.0
+            )
+            fleet[rid] = {
+                "batcher": batcher,
+                "reloader": reloader,
+                "servicer": ServingServicer(engine, batcher, reloader),
+                "client": None,
+            }
+
+        def client_factory(rid, _addr):
+            fleet[rid]["client"] = _Killable(fleet[rid]["servicer"])
+            return fleet[rid]["client"]
+
+        k8s = FakeK8sClient()
+        router = FleetRouter(retry_policy=RetryPolicy(
+            initial_backoff_s=0.001, max_backoff_s=0.01,
+            max_elapsed_s=30.0, max_attempts=8,
+        ))
+        manager = ServingFleetManager(
+            k8s,
+            ServingFleetConfig(
+                replicas=replicas, interval_s=0.0,
+                probe_failures=2, step_skew_slo=16,
+            ),
+            job_name="bench",
+            client_factory=client_factory,
+            reload_fn=lambda rid: fleet[rid]["reloader"].check_once(),
+            pending_step_fn=lambda: latest[0],
+            router=router,
+        )
+        manager.place()
+        manager.tick()  # prime: every replica probed healthy
+
+        sizes = (1, 2, 5, 8)  # mixed request sizes, exercising padding
+        latencies, failed = [], []
+        lock = threading.Lock()
+
+        def run_client(seed):
+            rng = np.random.RandomState(seed)
+            mine = []
+            for _ in range(requests_per_client):
+                n = sizes[rng.randint(len(sizes))]
+                x = rng.rand(n, 784).astype(np.float32)
+                t0 = time.perf_counter()
+                try:
+                    resp = router.predict(make_predict_request(x))
+                    ok = resp.code == spb.SERVING_OK
+                except Exception:
+                    ok = False
+                dt = time.perf_counter() - t0
+                if ok:
+                    mine.append(dt)
+                else:
+                    with lock:
+                        failed.append(seed)
+            with lock:
+                latencies.extend(mine)
+
+        threads = [
+            threading.Thread(target=run_client, args=(i,))
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        # mid-run chaos, while the clients hammer the router: kill one
+        # replica (transport AND pod), let a tick replace it, then land
+        # a newer checkpoint and roll it one replica per tick
+        time.sleep(0.1)
+        fleet[1]["client"].killed = True
+        k8s.emit(manager.snapshot()["replicas"][1]["pod"],
+                 PodStatus.FAILED, exit_code=1)
+        time.sleep(0.05)  # a probe-interval of traffic hits the dead pod
+        manager.tick()  # sees the FAILED pod -> relaunch
+        time.sleep(0.05)
+        save_step(2, 1.5)
+        latest[0] = 2
+        for _ in range(replicas + 1):
+            manager.tick()  # one sequenced hot-swap per tick
+            time.sleep(0.03)
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        snap = manager.snapshot()
+        stats = router.stats()
+        for rep in fleet.values():
+            rep["batcher"].shutdown()
+        saver.close()
+    lat_s = np.array(latencies) if latencies else np.array([0.0])
+    return {
+        "bench": "serving_fleet",
+        "value": round(len(latencies) / elapsed, 1),
+        "unit": "requests_per_sec",
+        "detail": {
+            "model": model_def,
+            "replicas": replicas,
+            "clients": clients,
+            "requests": clients * requests_per_client,
+            "p50_ms": round(float(np.percentile(lat_s, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat_s, 99)) * 1e3, 3),
+            "failed_requests": len(failed),
+            "failovers": stats["failovers"],
+            "relaunches": snap["relaunches"],
+            "reload_steps": snap["reload_steps"],
+            "max_model_step_skew": max(
+                snap["max_model_step_skew"],
+                router.max_observed_step_skew,
+            ),
+            "step_skew_slo": snap["step_skew_slo"],
+        },
+    }
+
+
 def bench_sparse_path(batch_size: int = 65536):
     """Sparse-path economics (`python bench.py --sparse-path`):
 
@@ -972,6 +1172,8 @@ def main():
         fn = {"full": bench_full, "deepfm": bench_deepfm,
               "mnist": bench_mnist, "bert": bench_bert,
               "serving": bench_serving,
+              "serving-fleet": bench_serving_fleet,
+              "serving_fleet": bench_serving_fleet,
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
               "e2e": lambda: bench_deepfm_e2e()}[which]

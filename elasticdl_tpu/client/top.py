@@ -108,6 +108,16 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
             f"evictions={policy.get('evictions_used', 0)}"
             f"/{policy.get('eviction_budget', 0)}{last_text}"
         )
+    fleet = snapshot.get("serving_fleet")
+    if fleet:
+        slo = fleet.get("step_skew_slo", 0)
+        lines.append(
+            f"fleet: replicas={len(fleet.get('replicas', {}))} "
+            f"relaunches={fleet.get('relaunches', 0)} "
+            f"reload_steps={fleet.get('reload_steps', 0)} "
+            f"skew={fleet.get('model_step_skew', 0)}"
+            f"/slo={slo if slo else '-'}"
+        )
     recovery = snapshot.get("recovery")
     if recovery:
         durations = recovery.get("recovery_durations_s", [])
@@ -156,6 +166,28 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
                     if entry.get("straggler") else "-",
                     14,
                 )
+            )
+    if fleet and fleet.get("replicas"):
+        lines.append("")
+        lines.append(
+            "replica".ljust(8)
+            + "addr".ljust(26)
+            + "healthy".rjust(8)
+            + "model_step".rjust(12)
+            + "fill".rjust(8)
+            + "shed".rjust(8)
+            + "relaunched".rjust(12)
+        )
+        for rid in sorted(fleet["replicas"], key=lambda r: int(r)):
+            entry = fleet["replicas"][rid]
+            lines.append(
+                str(rid).ljust(8)
+                + str(entry.get("addr", "-")).ljust(26)
+                + _fmt("yes" if entry.get("healthy") else "NO", 8)
+                + _fmt(entry.get("model_step", 0), 12)
+                + _fmt(entry.get("fill_ratio", 0.0), 8)
+                + _fmt(entry.get("shed", 0), 8)
+                + _fmt(entry.get("incarnation", 0), 12)
             )
     if serving_varz is not None:
         smetrics = serving_varz.get("metrics", {})

@@ -224,6 +224,36 @@ def add_common_params(parser: argparse.ArgumentParser):
         "'claim_name=cache,mount_path=/cache' "
         "--compilation_cache_dir /cache).",
     )
+    # ---- serving fleet (master/serving_fleet.py, docs/SERVING.md) ----
+    parser.add_argument(
+        "--serving_replicas", type=non_neg_int, default=0,
+        help="Serving replicas the master places and supervises behind "
+        "the job (docs/SERVING.md \"Fleet\").  0 (the default) disables "
+        "the serving fleet entirely.",
+    )
+    parser.add_argument(
+        "--serving_probe_interval", type=float, default=0.0,
+        help="Seconds between fleet health-probe ticks (probe every "
+        "replica's Health RPC, relaunch the dead, sequence rolling "
+        "reloads).  0 disables the background loop; tests tick by hand.",
+    )
+    parser.add_argument(
+        "--serving_probe_failures", type=pos_int, default=3,
+        help="Consecutive failed health probes before a serving replica "
+        "is relaunched (pod-phase death relaunches immediately).",
+    )
+    parser.add_argument(
+        "--serving_step_skew_slo", type=non_neg_int, default=0,
+        help="Max allowed cross-replica model_step spread.  A rolling "
+        "reload that would exceed it is refused (exported as the "
+        "serving_fleet_model_step_skew_count gauge).  0 disables the "
+        "bound.",
+    )
+    parser.add_argument(
+        "--serving_port", type=pos_int, default=50061,
+        help="gRPC port each serving replica listens on (the fleet "
+        "manager probes {replica-service}:{this port}).",
+    )
 
 
 def add_model_params(parser: argparse.ArgumentParser):

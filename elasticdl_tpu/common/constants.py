@@ -15,6 +15,7 @@ class PodStatus:
 class PodType:
     MASTER = "master"
     WORKER = "worker"
+    SERVING = "serving"
 
 
 class JobStatus:

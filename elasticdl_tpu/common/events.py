@@ -43,6 +43,9 @@ RECOVERY_DONE = "recovery_done"        # first post-restore progress
 STEP_PHASES = "step_phases"            # worker phase-time breakdown flush
 STRAGGLER_DETECTED = "straggler_detected"  # master flagged a slow worker
 POLICY_DECISION = "policy_decision"    # master policy engine acted
+SERVING_REPLICA_RELAUNCHED = "serving_replica_relaunched"  # fleet replaced
+FLEET_RELOAD_STEP = "fleet_reload_step"        # one replica hot-swapped
+FLEET_RELOAD_REFUSED = "fleet_reload_refused"  # skew SLO blocked a reload
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -52,7 +55,8 @@ VOCABULARY = frozenset({
     TASK_DISPATCHED, TASK_CLAIMED, TASK_TRAINED, TASK_REPORTED,
     CHECKPOINT_SAVED, CHECKPOINT_RESTORED, SERVING_RELOADED,
     RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
-    POLICY_DECISION,
+    POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
+    FLEET_RELOAD_REFUSED,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every

@@ -46,6 +46,13 @@ POINT_SERVING_RELOAD = "serving.reload"
 POINT_POD_CREATE = "pod.create"
 POINT_POD_DELETE = "pod.delete"
 POINT_POLICY_TICK = "policy.tick"
+# Serving-fleet boundaries (master/serving_fleet.py + the Health RPC):
+# a probe that errors, an apiserver that fails the replica replacement,
+# and a rolling-reload step that dies mid-swap are each one scheduled
+# fault away.
+POINT_RPC_HEALTH_PROBE = "rpc.health_probe"
+POINT_SERVING_REPLICA_KILL = "serving.replica_kill"
+POINT_FLEET_RELOAD_STEP = "fleet.reload_step"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -59,6 +66,9 @@ POINTS = (
     POINT_POD_CREATE,
     POINT_POD_DELETE,
     POINT_POLICY_TICK,
+    POINT_RPC_HEALTH_PROBE,
+    POINT_SERVING_REPLICA_KILL,
+    POINT_FLEET_RELOAD_STEP,
 )
 
 ACTIONS = ("raise", "delay", "drop")

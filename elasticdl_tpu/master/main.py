@@ -143,6 +143,7 @@ class Master:
         self.pod_manager = None
         self.recovery_clock = None
         self.policy_engine = None
+        self.serving_fleet = None
         self._k8s = k8s_client
         if k8s_client is not None:
             from elasticdl_tpu.master.pod_manager import PodManager
@@ -194,6 +195,25 @@ class Master:
                 self.pod_manager,
                 PolicyConfig.from_args(args),
                 telemetry_fn=self.servicer.worker_telemetry,
+            )
+        # Serving fleet supervisor (docs/SERVING.md "Fleet"): same
+        # construction gate as the policy engine — needs the pod
+        # machinery — plus an explicit replica count.
+        if (
+            self.pod_manager is not None
+            and getattr(args, "serving_replicas", 0) > 0
+        ):
+            from elasticdl_tpu.master.serving_fleet import (
+                ServingFleetConfig,
+                ServingFleetManager,
+            )
+
+            self.serving_fleet = ServingFleetManager(
+                k8s_client,
+                ServingFleetConfig.from_args(args),
+                job_name=args.job_name,
+                image=getattr(args, "image_name", ""),
+                command_fn=self._serving_command,
             )
         self._grpc_server = None
         self._done = threading.Event()
@@ -290,6 +310,22 @@ class Master:
             ]
         )
 
+    def _serving_command(self, replica_id: int):
+        """Serving replica pod command: `elasticdl serve` over the job's
+        live checkpoint dir, so every replica hot-reloads from the same
+        stream of steps the trainer writes."""
+        import sys
+
+        command = [
+            sys.executable, "-m", "elasticdl_tpu.client.main", "serve",
+            "--model_zoo", getattr(self.args, "model_zoo", "model_zoo"),
+            "--model_def", getattr(self.args, "model_def", ""),
+            "--port", str(getattr(self.args, "serving_port", 50061)),
+        ]
+        if getattr(self.args, "checkpoint_dir", ""):
+            command += ["--checkpoint_dir", self.args.checkpoint_dir]
+        return command
+
     def start(self, port: Optional[int] = None) -> int:
         """Serve gRPC, then (cluster mode) create the worker pods."""
         actual = self.start_grpc(port)
@@ -299,6 +335,13 @@ class Master:
             logger.info(
                 "Policy engine ticking every %.1fs",
                 self.policy_engine.config.interval_s,
+            )
+        if self.serving_fleet is not None:
+            self.serving_fleet.start()
+            logger.info(
+                "Serving fleet: %d replicas placed (probe interval %.1fs)",
+                self.serving_fleet.config.replicas,
+                self.serving_fleet.config.interval_s,
             )
         # A restored task journal may already be terminal (all shards of
         # the final epoch done): no worker report will ever drain the
@@ -398,6 +441,8 @@ class Master:
             out["pods"] = self.pod_manager.snapshot()
         if self.policy_engine is not None:
             out["policy"] = self.policy_engine.snapshot()
+        if self.serving_fleet is not None:
+            out["serving_fleet"] = self.serving_fleet.snapshot()
         out["workers"] = self.servicer.worker_telemetry()
         # Straggler stats come from the task manager's lease clock, not
         # from worker self-reports — merge them onto the same per-worker
@@ -423,6 +468,8 @@ class Master:
             registries.append(self.pod_manager.metrics_registry)
         if self.policy_engine is not None:
             registries.append(self.policy_engine.metrics_registry)
+        if self.serving_fleet is not None:
+            registries.append(self.serving_fleet.metrics_registry)
         return registries
 
     def start_telemetry(self, port: int = 0) -> Optional[int]:
@@ -458,6 +505,8 @@ class Master:
     def stop(self):
         if self.policy_engine is not None:
             self.policy_engine.stop()
+        if self.serving_fleet is not None:
+            self.serving_fleet.stop()
         if self.pod_manager is not None:
             self.pod_manager.stop()
         if self._grpc_server is not None:

@@ -14,6 +14,8 @@ traffic lives on the device mesh in the TPU-native design.
 
 from __future__ import annotations
 
+import threading
+
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common import metrics as _metrics
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -76,9 +78,11 @@ METHOD_FAULT_POINTS = {
 
 
 # The online-serving data plane (serving.proto; docs/SERVING.md).
-# `health` carries no fault point: it is the probe used to decide whether
-# to restart a replica, and injecting failures into the prober makes every
-# chaos schedule flap the fleet instead of testing the data path.
+# `health` fires its own point, distinct from the data path: the fleet
+# manager's probe loop is itself a chaos surface (a probe that errors must
+# count toward the relaunch threshold deterministically), and a separate
+# point means a schedule can flap the prober without touching predict
+# traffic — or vice versa.
 SERVING_METHODS = {
     "predict": (spb.PredictRequest, spb.PredictResponse),
     "health": (spb.HealthRequest, spb.HealthResponse),
@@ -86,6 +90,7 @@ SERVING_METHODS = {
 
 SERVING_METHOD_FAULT_POINTS = {
     "predict": faults.POINT_RPC_PREDICT,
+    "health": faults.POINT_RPC_HEALTH_PROBE,
 }
 
 
@@ -249,3 +254,219 @@ class InProcessServingClient(_InProcessClientBase):
     _service_name = SERVING_SERVICE_NAME
     _methods = SERVING_METHODS
     _fault_points = SERVING_METHOD_FAULT_POINTS
+
+
+# Router-side fan-out counters: how often a request left its first-choice
+# replica, and why.  Shared across router instances on purpose — the
+# cluster-wide view is the one `elasticdl top` and the bench read.
+_fleet_requests_counter = _metrics.default_registry().counter(
+    "rpc_fleet_requests_total",
+    "Predict requests entering the fleet router",
+)
+_fleet_failovers_counter = _metrics.default_registry().counter(
+    "rpc_fleet_failovers_total",
+    "requests re-offered to another replica, by reason",
+    labelnames=("reason",),
+)
+
+#: In-band codes the router treats as routing signals: the replica is up
+#: but refusing load, so re-offer elsewhere — never re-offer through the
+#: retry interceptor (that would re-load a shedding server).
+SHED_CODES = (spb.SERVING_OVERLOADED, spb.SERVING_SHUTTING_DOWN)
+
+
+class FleetRouter:
+    """Client-side Predict fan-out across serving replicas
+    (docs/SERVING.md "Fleet").
+
+    Holds one client per replica id — `ServingStub` or
+    `InProcessServingClient`, the transports are interchangeable — and
+    routes every request through the unified resilience policy
+    (common/resilience.py): `predict()` wraps a single sweep of the
+    fleet in `retry_policy.call`, so the public entry point is the
+    interceptor (scripts/check_no_naked_retries.py enforces this shape).
+
+    Failure semantics, per sweep:
+
+    - A transport error (killed replica, injected fault) demotes the
+      replica and moves on to the next candidate.  Only when EVERY
+      replica errors does the sweep raise — the policy then backs off
+      and re-sweeps, so a replica kill costs retries, not client errors.
+    - In-band OVERLOADED / SHUTTING_DOWN responses are routing signals,
+      not errors: the shedding replica is demoted and the request is
+      offered to at most one other replica per candidate; when the whole
+      fleet sheds, the shed response is returned as-is (rerouting must
+      not turn admission control into a retry storm).
+    - Ranking is deterministic (no RNG): demotion bucket first, then the
+      batcher fill-ratio bucket fed by `observe_health()` (the fleet
+      manager's probe loop scrapes it from each replica's Health RPC),
+      with round-robin rotation breaking ties — so equal replicas share
+      load and a loaded replica drains before it sheds.
+    """
+
+    def __init__(self, clients=None, retry_policy=None):
+        if retry_policy is None:
+            from elasticdl_tpu.common.resilience import default_policy
+
+            retry_policy = default_policy()
+        self._retry_policy = retry_policy
+        self._lock = threading.Lock()
+        self._clients = dict(clients or {})
+        self._penalty = {rid: 0 for rid in self._clients}
+        self._fill = {rid: 0.0 for rid in self._clients}
+        self._down = set()
+        self._steps = {}
+        self._rr = 0
+        self._max_skew = 0
+        self._failovers = {"error": 0, "overloaded": 0, "shutdown": 0}
+
+    # ---- fleet membership (driven by the ServingFleetManager) ---------
+
+    def set_client(self, replica_id, client) -> None:
+        """Install or replace the client for one replica (a relaunch
+        hands the router a fresh transport and a clean slate)."""
+        with self._lock:
+            self._clients[replica_id] = client
+            self._penalty[replica_id] = 0
+            self._fill.setdefault(replica_id, 0.0)
+            self._down.discard(replica_id)
+
+    def remove_client(self, replica_id) -> None:
+        with self._lock:
+            self._clients.pop(replica_id, None)
+            self._penalty.pop(replica_id, None)
+            self._fill.pop(replica_id, None)
+            self._steps.pop(replica_id, None)
+            self._down.discard(replica_id)
+
+    def mark_down(self, replica_id) -> None:
+        """Probe-driven: stop offering traffic until `set_client` or
+        `mark_live` readmits the replica."""
+        with self._lock:
+            self._down.add(replica_id)
+
+    def mark_live(self, replica_id) -> None:
+        with self._lock:
+            self._down.discard(replica_id)
+            self._penalty[replica_id] = 0
+
+    def observe_health(self, replica_id, fill_ratio=0.0, queue_depth=0,
+                       model_step=None) -> None:
+        """Feed one probe result into the ranking (fill-ratio weighting)
+        and the cross-replica skew bookkeeping."""
+        del queue_depth  # fill-ratio is the load signal; depth rides along
+        with self._lock:
+            if replica_id not in self._clients:
+                return
+            self._fill[replica_id] = float(fill_ratio)
+            if model_step is not None:
+                self._note_step_locked(replica_id, int(model_step))
+
+    def replica_ids(self):
+        with self._lock:
+            return sorted(self._clients)
+
+    # ---- skew observation ---------------------------------------------
+
+    def _note_step_locked(self, replica_id, step: int) -> None:
+        self._steps[replica_id] = step
+        live = [s for r, s in self._steps.items() if r in self._clients]
+        if len(live) > 1:
+            self._max_skew = max(self._max_skew, max(live) - min(live))
+
+    def observed_step_skew(self) -> int:
+        """Current max-min `model_step` across replicas, from the steps
+        echoed in responses and probes."""
+        with self._lock:
+            live = [s for r, s in self._steps.items() if r in self._clients]
+            return max(live) - min(live) if len(live) > 1 else 0
+
+    @property
+    def max_observed_step_skew(self) -> int:
+        with self._lock:
+            return self._max_skew
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "replicas": len(self._clients),
+                "down": sorted(self._down),
+                "failovers": dict(self._failovers),
+                "max_model_step_skew": self._max_skew,
+            }
+
+    # ---- routing ------------------------------------------------------
+
+    def _ranked(self):
+        """Candidate order for one sweep: demotion bucket, then fill
+        bucket, round-robin rotation within equal buckets.  All-down
+        fleets still return candidates — a stale down-mark must not turn
+        into an outage when the replicas are actually back."""
+        with self._lock:
+            rids = [r for r in sorted(self._clients) if r not in self._down]
+            if not rids:
+                rids = sorted(self._clients)
+            if not rids:
+                return []
+            offset = self._rr % len(rids)
+            self._rr += 1
+            rotated = rids[offset:] + rids[:offset]
+            return sorted(
+                rotated,
+                key=lambda r: (
+                    min(self._penalty.get(r, 0), 3),
+                    round(self._fill.get(r, 0.0), 1),
+                ),
+            )
+
+    def _sweep(self, request, timeout=None):
+        """One pass over the ranked fleet; raises (retryably) only when
+        every replica failed at the transport layer."""
+        order = self._ranked()
+        if not order:
+            raise ConnectionError("fleet router has no serving replicas")
+        shed_response = None
+        last_error = None
+        for rid in order:
+            with self._lock:
+                client = self._clients.get(rid)
+            if client is None:
+                continue
+            try:
+                response = client.predict(request, timeout=timeout)
+            except Exception as exc:  # transport/injected: demote, move on
+                last_error = exc
+                with self._lock:
+                    self._penalty[rid] = self._penalty.get(rid, 0) + 1
+                    self._failovers["error"] += 1
+                _fleet_failovers_counter.labels(reason="error").inc()
+                continue
+            if response.code in SHED_CODES:
+                reason = (
+                    "overloaded"
+                    if response.code == spb.SERVING_OVERLOADED
+                    else "shutdown"
+                )
+                with self._lock:
+                    self._penalty[rid] = self._penalty.get(rid, 0) + 1
+                    self._failovers[reason] += 1
+                _fleet_failovers_counter.labels(reason=reason).inc()
+                shed_response = response
+                continue
+            with self._lock:
+                self._penalty[rid] = 0
+                self._note_step_locked(rid, int(response.model_step))
+            return response
+        if shed_response is not None:
+            return shed_response
+        raise last_error
+
+    def predict(self, request, timeout=None):
+        """Route one Predict through the resilience policy: each attempt
+        is a full fleet sweep, so backoff only happens when no replica
+        could take the request at all."""
+        _fleet_requests_counter.inc()
+        return self._retry_policy.call(
+            lambda: self._sweep(request, timeout=timeout),
+            description="fleet_predict",
+        )

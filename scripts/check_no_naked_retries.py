@@ -23,6 +23,16 @@ k8s watch reconnect loop).  The policy's own sleep goes through an
 injected `self._sleep`, so resilience.py passes by construction; it is
 also explicitly allowlisted to stay robust against refactors there.
 
+A second rule covers the serving-fleet router path: in any `*Router`
+class, a PUBLIC method that calls `<replica>.predict(...)` directly must
+also route through `<policy>.call(...)` in its own body — i.e. Predict
+fan-out enters through the unified resilience policy, and the raw
+per-replica sweep stays a private helper the policy wraps
+(proto/service.py FleetRouter is the canonical shape: `predict()` is
+`retry_policy.call(lambda: self._sweep(...))`).  Without this, a future
+"fast path" that fans out to replicas bare would silently lose the
+backoff/budget/failover guarantees docs/SERVING.md promises.
+
 Exit status: 0 when clean, 1 with one `path:line: message` per finding.
 """
 
@@ -75,6 +85,40 @@ def find_naked_retries(tree: ast.AST):
                             )
 
 
+def _calls_attr(tree: ast.AST, attr: str) -> bool:
+    """True when `tree` contains a call of the form `<x>.<attr>(...)`."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            return True
+    return False
+
+
+def find_unguarded_router_fanout(tree: ast.AST):
+    """Yield (lineno, description) for public `*Router` methods that call
+    `.predict(...)` on a replica client without routing through a
+    resilience policy's `.call(...)` in the same method."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Router")):
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name.startswith("_"):
+                continue  # private helpers are the policy's wrapped body
+            if _calls_attr(item, "predict") and not _calls_attr(item, "call"):
+                yield (
+                    item.lineno,
+                    f"{node.name}.{item.name} fans Predict out to "
+                    "replicas without resilience.RetryPolicy.call — "
+                    "public router entry points must go through the "
+                    "unified policy (keep the raw sweep in a private "
+                    "helper the policy wraps)",
+                )
+
+
 def check_file(path: str):
     with open(path, "rb") as f:
         source = f.read()
@@ -82,7 +126,9 @@ def check_file(path: str):
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
         return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
-    return list(find_naked_retries(tree))
+    return list(find_naked_retries(tree)) + list(
+        find_unguarded_router_fanout(tree)
+    )
 
 
 def main(argv=None) -> int:

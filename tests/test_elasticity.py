@@ -326,11 +326,16 @@ def test_group_restart_on_member_failure():
     for w in new:
         assert manager._relaunch_count.get(w, 0) <= 1
 
-    # a scale-down delete must NOT trigger group restarts
+    # a scale-down delete must NOT trigger group restarts; with
+    # workers_per_group=2 the step is one whole group (a partial step is
+    # refused, never split — docs/ROBUSTNESS.md)
     before = set(manager.alive_workers())
     manager.scale_down(1)
+    assert set(manager.alive_workers()) == before  # sub-group: refused
+    manager.scale_down(2)
     after = set(manager.alive_workers())
-    assert len(before - after) == 1, "scale_down removed exactly one"
+    assert len(before - after) == 2, "scale_down removed one whole group"
+    assert len(after) == 2  # the surviving group did not cascade-restart
 
 
 def test_group_size_one_is_per_worker_granularity():

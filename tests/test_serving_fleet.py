@@ -1,0 +1,437 @@
+"""Serving-fleet acceptance: a 3-replica in-process fleet survives a
+replica kill (router failover -> zero failed requests, manager relaunch),
+probe-failure-driven replacement, and a rolling hot-reload under live
+traffic that holds the model_step skew SLO — all deterministic under the
+seeded fault plan, with byte-stable decision/event traces across
+same-seed runs (docs/SERVING.md "Fleet", docs/ROBUSTNESS.md)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.k8s_client import FakeK8sClient
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.common.resilience import RetryPolicy
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.master.serving_fleet import (
+    ServingFleetConfig,
+    ServingFleetManager,
+)
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.proto.service import FleetRouter, InProcessServingClient
+from elasticdl_tpu.serving.batcher import DynamicBatcher
+from elasticdl_tpu.serving.engine import ServingEngine
+from elasticdl_tpu.serving.reloader import CheckpointReloader
+from elasticdl_tpu.serving.server import (
+    ServingServicer,
+    from_tensor_proto,
+    make_predict_request,
+)
+from elasticdl_tpu.worker.trainer import TrainState
+
+MODEL_DEF = "mnist.mnist_functional_api.custom_model"
+BUCKETS = (2,)  # one bucket keeps the per-replica precompile bill at 1
+REPLICAS = 3
+SEED = 20260805
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    faults.uninstall()
+    events.configure(None)
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _no_sleep_policy(max_attempts=8):
+    return RetryPolicy(
+        initial_backoff_s=0.0, max_backoff_s=0.0, max_elapsed_s=30.0,
+        max_attempts=max_attempts, sleep=lambda _s: None,
+    )
+
+
+class KillableClient:
+    """In-process serving client with a kill switch standing in for a
+    dead pod: once killed, every call fails at the transport layer."""
+
+    def __init__(self, servicer):
+        self._inner = InProcessServingClient(servicer)
+        self.killed = False
+
+    def predict(self, request, timeout=None):
+        if self.killed:
+            raise ConnectionError("replica killed")
+        return self._inner.predict(request, timeout=timeout)
+
+    def health(self, request, timeout=None):
+        if self.killed:
+            raise ConnectionError("replica killed")
+        return self._inner.health(request, timeout=timeout)
+
+
+class _Fleet:
+    """Three real serving replicas (engine + batcher + reloader) over one
+    checkpoint dir, a FleetRouter, and a tick-driven ServingFleetManager
+    wired through injectable collaborators — no sockets, no pods."""
+
+    def __init__(self, tmp_path, skew_slo=0, probe_failures=2):
+        self.spec = get_model_spec("model_zoo", MODEL_DEF)
+        self.sample = np.random.RandomState(0).rand(2, 784).astype(
+            np.float32
+        )
+        variables = dict(
+            self.spec.model.init(jax.random.PRNGKey(0), self.sample)
+        )
+        self.params = {"params": variables.pop("params")}
+        self.model_state = variables
+        self.ckpt_dir = str(tmp_path / "ckpts")
+        self.saver = CheckpointSaver(self.ckpt_dir, async_save=False)
+        self.latest_step = None
+        self.save_step(1)
+
+        self.replicas = {}
+        for rid in range(REPLICAS):
+            engine = ServingEngine.from_checkpoint(
+                self.ckpt_dir, self.spec, self.sample, buckets=BUCKETS
+            )
+            batcher = DynamicBatcher(engine, max_latency_s=0.002)
+            reloader = CheckpointReloader(
+                engine, self.ckpt_dir, poll_interval_s=3600.0
+            )
+            servicer = ServingServicer(engine, batcher, reloader)
+            self.replicas[rid] = {
+                "engine": engine, "batcher": batcher,
+                "reloader": reloader, "servicer": servicer,
+                "client": KillableClient(servicer),
+            }
+
+        self.k8s = FakeK8sClient()
+        self.clock = FakeClock()
+        self.router = FleetRouter(retry_policy=_no_sleep_policy())
+        self.manager = ServingFleetManager(
+            self.k8s,
+            ServingFleetConfig(
+                replicas=REPLICAS, interval_s=0.0,
+                probe_failures=probe_failures, step_skew_slo=skew_slo,
+            ),
+            job_name="fleet",
+            client_factory=self._client_factory,
+            reload_fn=self._reload_replica,
+            pending_step_fn=lambda: self.latest_step,
+            router=self.router,
+            clock=self.clock,
+        )
+        self.manager.place()
+        self.request = make_predict_request(self.sample)
+
+    def _client_factory(self, rid, _address):
+        # Each (re)launch hands the router a fresh, un-killed transport
+        # onto the same in-process servicer — the "restarted pod".
+        rep = self.replicas[rid]
+        rep["client"] = KillableClient(rep["servicer"])
+        return rep["client"]
+
+    def _reload_replica(self, rid):
+        return self.replicas[rid]["reloader"].check_once()
+
+    def save_step(self, step, scale=1.0):
+        params = jax.tree.map(lambda a: a * scale, self.params)
+        state = TrainState(
+            step=jnp.asarray(step, jnp.int32), params=params,
+            opt_state=self.spec.optimizer.init(params),
+            model_state=self.model_state,
+        )
+        self.saver.save(state, force=True)
+        self.saver.wait_until_finished()
+        self.latest_step = step
+
+    def kill(self, rid):
+        """Kill one replica the way a preemption does: transport dies AND
+        the pod goes FAILED (the manager's phase check sees it next
+        tick)."""
+        self.replicas[rid]["client"].killed = True
+        pod = self.manager.snapshot()["replicas"][rid]["pod"]
+        self.k8s.emit(pod, PodStatus.FAILED, exit_code=1)
+
+    def step_tick(self, dt=1.0):
+        records = self.manager.tick()
+        self.clock.advance(dt)
+        return records
+
+    def close(self):
+        for rep in self.replicas.values():
+            rep["batcher"].shutdown()
+        self.saver.close()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = _Fleet(tmp_path, skew_slo=10, probe_failures=2)
+    yield f
+    f.close()
+
+
+# ---- pure-logic placement/probing (no engines) --------------------------
+
+
+class _StubHealthClient:
+    """Canned Health responses for manager-logic tests."""
+
+    def __init__(self, step):
+        self.step = step
+
+    def health(self, _request, timeout=None):
+        return spb.HealthResponse(
+            serving=True, model_step=self.step, queue_depth=2,
+            metrics=[
+                spb.ScalarMetric(name="batch_fill_ratio", value=0.5),
+                spb.ScalarMetric(name="shed", value=3.0),
+            ],
+        )
+
+    def predict(self, request, timeout=None):  # pragma: no cover
+        raise NotImplementedError
+
+
+def test_placement_and_probe_bookkeeping():
+    k8s = FakeK8sClient()
+    steps = {0: 3, 1: 3, 2: 9}
+    router = FleetRouter(retry_policy=_no_sleep_policy())
+    manager = ServingFleetManager(
+        k8s,
+        ServingFleetConfig(replicas=3, interval_s=0.0),
+        job_name="j",
+        client_factory=lambda rid, _addr: _StubHealthClient(steps[rid]),
+        router=router,
+        clock=FakeClock(),
+    )
+    assert manager.place() == 3
+    assert manager.place() == 0  # idempotent
+    assert manager.start() is False  # interval 0: no background loop
+    # every slot got a pod + a stable per-replica service address
+    snap = manager.snapshot()
+    assert snap["replicas"][1]["pod"] == "j-serving-1-0"
+    assert snap["replicas"][1]["addr"] == "j-serving-1"
+    assert k8s.get_pod_phase("j-serving-2-0") == PodStatus.RUNNING
+
+    records = manager.tick()
+    assert records == []  # healthy fleet: nothing to decide
+    snap = manager.snapshot()
+    assert all(r["healthy"] for r in snap["replicas"].values())
+    assert snap["replicas"][2]["model_step"] == 9
+    assert snap["replicas"][0]["fill_ratio"] == 0.5
+    assert snap["replicas"][0]["shed"] == 3
+    assert snap["model_step_skew"] == 6  # 9 - 3, probes feed the gauge
+    assert router.observed_step_skew() == 6
+    manager.stop()  # no-op, must not raise
+
+
+# ---- replica kill: failover + relaunch ----------------------------------
+
+
+def test_replica_kill_failover_and_relaunch(fleet):
+    fleet.step_tick()  # prime: all replicas probed healthy
+    codes = [fleet.router.predict(fleet.request).code for _ in range(6)]
+
+    fleet.kill(1)
+    # traffic continues across the kill: the router fails over within a
+    # sweep, so not one client request fails
+    codes += [fleet.router.predict(fleet.request).code for _ in range(6)]
+    assert fleet.router.stats()["failovers"]["error"] >= 1
+
+    records = fleet.step_tick()  # manager sees the FAILED pod
+    assert [r["action"] for r in records] == ["relaunch"]
+    assert records[0]["cause"] == "pod_dead"
+    assert records[0]["replica"] == 1
+
+    codes += [fleet.router.predict(fleet.request).code for _ in range(6)]
+    assert codes == [spb.SERVING_OK] * 18  # zero failed requests
+
+    snap = fleet.manager.snapshot()
+    assert snap["relaunches"] == 1
+    assert snap["replicas"][1]["incarnation"] == 1
+    assert snap["replicas"][1]["pod"] == "fleet-serving-1-1"
+    # the replacement transport really serves
+    resp = fleet.replicas[1]["client"].predict(fleet.request)
+    assert resp.code == spb.SERVING_OK
+
+
+def test_probe_failures_trigger_relaunch(fleet):
+    # Probe order is sorted by replica id, one health_probe hit per
+    # replica per tick: hits 1 and 4 are replica 1 in ticks 1 and 2.
+    reg = faults.install(FaultRegistry(
+        [
+            FaultSpec(faults.POINT_RPC_HEALTH_PROBE, 1, "raise"),
+            FaultSpec(faults.POINT_RPC_HEALTH_PROBE, 4, "raise"),
+        ],
+        seed=SEED,
+    ))
+    assert fleet.step_tick() == []  # failure 1/2: below threshold
+    assert fleet.manager.snapshot()["replicas"][1]["probe_failures"] == 1
+
+    records = fleet.step_tick()  # failure 2/2: relaunch
+    assert [(r["action"], r["replica"], r["cause"]) for r in records] == [
+        ("relaunch", 1, "probe")
+    ]
+    assert reg.all_fired(), reg.unfired()
+
+    fleet.step_tick()  # fresh incarnation probes healthy again
+    snap = fleet.manager.snapshot()
+    assert snap["replicas"][1]["healthy"]
+    assert snap["replicas"][1]["probe_failures"] == 0
+    assert snap["replicas"][1]["incarnation"] == 1
+
+
+# ---- rolling hot-reload under the skew SLO ------------------------------
+
+
+def test_rolling_reload_holds_skew_slo_under_traffic(fleet):
+    fleet.step_tick()  # all healthy at step 1
+    fleet.save_step(5, scale=2.0)
+
+    codes = []
+    for _ in range(3):  # one sequenced swap per tick
+        codes.append(fleet.router.predict(fleet.request).code)
+        records = fleet.step_tick()
+        codes.append(fleet.router.predict(fleet.request).code)
+        assert [r["action"] for r in records] == ["reload_step"]
+    assert codes == [spb.SERVING_OK] * 6
+
+    snap = fleet.manager.snapshot()
+    assert snap["reload_steps"] == 3
+    assert [d["replica"] for d in snap["decisions"]] == [0, 1, 2]
+    assert all(
+        r["model_step"] == 5 for r in snap["replicas"].values()
+    )
+    assert all(
+        fleet.replicas[rid]["engine"].step == 5 for rid in range(REPLICAS)
+    )
+    # mid-roll spread stayed within the SLO, on both sides of the wire
+    assert snap["max_model_step_skew"] == 4 <= 10
+    assert fleet.router.max_observed_step_skew <= 10
+
+    # a checkpoint 45 steps ahead would blow the SLO: refused, terminally
+    fleet.save_step(50, scale=3.0)
+    records = fleet.step_tick()
+    assert [r["action"] for r in records] == ["reload_refused"]
+    assert records[0]["projected_skew"] == 45
+    assert records[0]["slo"] == 10
+    assert fleet.step_tick() == []  # refusal is terminal per target
+    snap = fleet.manager.snapshot()
+    assert snap["reload_steps"] == 3  # nothing swapped
+    assert all(
+        fleet.replicas[rid]["engine"].step == 5 for rid in range(REPLICAS)
+    )
+
+
+# ---- the chaos scenario: byte-stable across same-seed runs ---------------
+
+_FLEET_EVENTS = (
+    "serving_replica_relaunched", "fleet_reload_step", "fleet_reload_refused",
+)
+
+
+def _fleet_event_projection(evts):
+    """Fleet span events minus the run-variant fields."""
+    return json.dumps(
+        [
+            {k: v for k, v in e.items() if k not in ("ts", "pid")}
+            for e in evts
+            if e.get("event") in _FLEET_EVENTS
+        ],
+        sort_keys=True,
+    )
+
+
+def _chaos_run(tmp_path, event_log):
+    """One fully deterministic chaos run: replica 1's probe flaps three
+    ticks running (hits 1/4/7), the first relaunch attempt is aborted by
+    an injected apiserver failure (serving.replica_kill hit 0), the
+    retry next tick lands; then a rolling reload to step 5 whose first
+    sequenced swap is aborted (fleet.reload_step hit 0) and retried.
+    Client traffic rides through all of it."""
+    events.configure(event_log, role="master")
+    f = _Fleet(tmp_path, skew_slo=10, probe_failures=2)
+    reg = faults.install(FaultRegistry(
+        [
+            FaultSpec(faults.POINT_RPC_HEALTH_PROBE, 1, "raise"),
+            FaultSpec(faults.POINT_RPC_HEALTH_PROBE, 4, "raise"),
+            FaultSpec(faults.POINT_RPC_HEALTH_PROBE, 7, "raise"),
+            FaultSpec(faults.POINT_SERVING_REPLICA_KILL, 0, "raise"),
+            FaultSpec(faults.POINT_FLEET_RELOAD_STEP, 0, "raise"),
+        ],
+        seed=SEED,
+    ))
+    reg.note("scenario", "probe-flap-then-rolling-reload")
+    try:
+        codes = []
+        for tick in range(1, 9):
+            if tick == 4:
+                f.save_step(5, scale=2.0)
+            f.step_tick()
+            codes.append(f.router.predict(f.request).code)
+        snapshot = f.manager.snapshot()
+        decisions = list(f.manager.decisions)
+    finally:
+        f.close()
+        faults.uninstall()
+        events.configure(None)
+    return {
+        "codes": codes,
+        "snapshot": snapshot,
+        "decisions_json": json.dumps(decisions, sort_keys=True),
+        "events": _fleet_event_projection(events.read_events(event_log)),
+        "trace": reg.trace_text(),
+        "registry": reg,
+    }
+
+
+def test_chaos_fleet_scenario(tmp_path):
+    run = _chaos_run(tmp_path / "run_a", str(tmp_path / "a.jsonl"))
+
+    # every scheduled fault fired — the scenario exercised its plan
+    assert run["registry"].all_fired(), run["registry"].unfired()
+    # zero failed client requests through probe flaps, an aborted+retried
+    # relaunch, and the rolling reload
+    assert run["codes"] == [spb.SERVING_OK] * 8
+
+    actions = [d["action"] for d in json.loads(run["decisions_json"])]
+    assert actions == [
+        "relaunch_aborted",  # tick 2: threshold hit, apiserver fault
+        "relaunch",          # tick 3: retried, lands
+        "reload_aborted",    # tick 4: first sequenced swap fault-aborted
+        "reload_step",       # tick 5: retried on the same victim
+        "reload_step",       # tick 6
+        "reload_step",       # tick 7; tick 8 has nothing left to do
+    ]
+    snap = run["snapshot"]
+    assert snap["relaunches"] == 1
+    assert snap["reload_steps"] == 3
+    assert snap["replicas"][1]["incarnation"] == 1
+    assert all(r["model_step"] == 5 for r in snap["replicas"].values())
+    assert snap["max_model_step_skew"] == 4 <= snap["step_skew_slo"]
+
+
+def test_chaos_fleet_traces_are_byte_stable(tmp_path):
+    run_a = _chaos_run(tmp_path / "run_a", str(tmp_path / "a.jsonl"))
+    run_b = _chaos_run(tmp_path / "run_b", str(tmp_path / "b.jsonl"))
+    assert run_a["decisions_json"] == run_b["decisions_json"]
+    assert run_a["events"] == run_b["events"]
+    assert run_a["trace"] == run_b["trace"]
+    assert run_a["codes"] == run_b["codes"]
