@@ -246,7 +246,7 @@ def add_common_params(parser: argparse.ArgumentParser):
         "--serving_step_skew_slo", type=non_neg_int, default=0,
         help="Max allowed cross-replica model_step spread.  A rolling "
         "reload that would exceed it is refused (exported as the "
-        "serving_fleet_model_step_skew_count gauge).  0 disables the "
+        "serving_fleet_model_step_skew_steps gauge).  0 disables the "
         "bound.",
     )
     parser.add_argument(

@@ -53,6 +53,7 @@ ALLOWED_UNIT_SUFFIXES = (
     "_count",
     "_rows",
     "_step",
+    "_steps",  # a step-distance (e.g. cross-replica skew), not a position
     "_epoch",
     "_info",
 )
@@ -77,6 +78,14 @@ def validate_metric_name(name: str) -> Optional[str]:
         return (
             f"{name!r} does not end with a unit suffix "
             f"({', '.join(ALLOWED_UNIT_SUFFIXES)})"
+        )
+    suffix = max(
+        (s for s in ALLOWED_UNIT_SUFFIXES if name.endswith(s)), key=len
+    )
+    if not name[len(subsystem):-len(suffix)].strip("_"):
+        return (
+            f"{name!r} is only a subsystem and a unit — a metric also "
+            "needs a name between them (subsystem_name_unit)"
         )
     return None
 

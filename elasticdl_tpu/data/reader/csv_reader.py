@@ -115,4 +115,7 @@ class CSVDataReader(AbstractDataReader):
 
     @property
     def metadata(self):
-        return {"columns": self._columns}
+        # _columns is filled under the lock by the first _file() index;
+        # read it under the same lock (GL-LOCK).
+        with self._lock:
+            return {"columns": self._columns}

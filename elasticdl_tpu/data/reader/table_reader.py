@@ -132,10 +132,11 @@ class TableDataReader(AbstractDataReader):
         rowids = self._record_rowids()
         cols = ", ".join(f'"{c}"' for c in self._columns)
         if rowids is None:
-            lo, hi = (
-                self._rowid_base + task.shard.start,
-                self._rowid_base + task.shard.end,
-            )
+            # _rowid_base is set under _index_lock by _record_rowids();
+            # read it under the same lock (GL-LOCK).
+            with self._index_lock:
+                base = self._rowid_base
+            lo, hi = base + task.shard.start, base + task.shard.end
         else:
             if task.shard.start >= len(rowids):
                 return

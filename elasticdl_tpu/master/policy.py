@@ -400,15 +400,19 @@ class PolicyEngine:
         return record
 
     def snapshot(self) -> dict:
-        return {
-            "ticks": self._tick_count,
-            "evictions_used": self._evictions_used,
-            "eviction_budget": self.config.eviction_budget,
-            "backlog_streak": self._backlog_streak,
-            "data_wait_streak": self._data_wait_streak,
-            "hold_ticks": self._hold_ticks,
-            "backlog_per_worker": round(self._last_backlog_ratio, 3),
-            "data_wait_ratio": round(self._last_data_wait_ratio, 3),
-            "decisions": list(self.decisions),
-            "interval_s": self.config.interval_s,
-        }
+        # Taken under the lock: snapshot() runs on the master/telemetry
+        # thread while the tick loop mutates these counters under
+        # self._lock (GL-LOCK).
+        with self._lock:
+            return {
+                "ticks": self._tick_count,
+                "evictions_used": self._evictions_used,
+                "eviction_budget": self.config.eviction_budget,
+                "backlog_streak": self._backlog_streak,
+                "data_wait_streak": self._data_wait_streak,
+                "hold_ticks": self._hold_ticks,
+                "backlog_per_worker": round(self._last_backlog_ratio, 3),
+                "data_wait_ratio": round(self._last_data_wait_ratio, 3),
+                "decisions": list(self.decisions),
+                "interval_s": self.config.interval_s,
+            }

@@ -22,8 +22,8 @@ fleet-level guarantees here checkable:
   replica per tick, and refuses the reload outright when the projected
   cross-replica `model_step` spread would exceed
   `--serving_step_skew_slo` (exported as the
-  `serving_fleet_model_step_skew_count` gauge; the metric-name contract
-  in common/metrics.py requires the `_count` unit suffix).
+  `serving_fleet_model_step_skew_steps` gauge — the skew is a distance
+  measured in steps, and `_steps` is its unit suffix).
 
 Determinism is load-bearing, exactly as in the policy engine: the loop
 takes an injectable `clock`, fires `serving.replica_kill` before every
@@ -204,11 +204,10 @@ class ServingFleetManager:
             "replicas that passed their last health probe",
         )
         self.metrics_registry.gauge_fn(
-            "serving_fleet_model_step_skew_count",
+            "serving_fleet_model_step_skew_steps",
             lambda: float(self._last_skew),
             "max-min model_step across probed replicas (the skew SLO "
-            "gauge; _count is the unit suffix the naming contract "
-            "requires)",
+            "gauge, measured in steps)",
         )
 
     # ---- lifecycle -----------------------------------------------------

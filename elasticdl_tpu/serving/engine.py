@@ -377,15 +377,19 @@ class ServingEngine:
         match the current one in structure/shape/dtype — the jitted
         buckets were compiled against those avals, and a mismatch would
         force a recompile (or worse, wrong results) mid-traffic."""
-        old_shapes = jax.eval_shape(lambda t: t, self._variables)
         new_shapes = jax.eval_shape(lambda t: t, variables)
-        if old_shapes != new_shapes:
-            raise ValueError(
-                "swap rejected: new variables do not match the served "
-                "tree (structure/shape/dtype drift); restart serving "
-                "with the new model instead of hot-swapping"
-            )
+        # Check-and-set under one lock hold: reading self._variables for
+        # the shape check outside it would let two concurrent swaps
+        # validate against the same old tree (GL-LOCK).  eval_shape is
+        # abstract — no device work happens in the critical section.
         with self._lock:
+            old_shapes = jax.eval_shape(lambda t: t, self._variables)
+            if old_shapes != new_shapes:
+                raise ValueError(
+                    "swap rejected: new variables do not match the "
+                    "served tree (structure/shape/dtype drift); restart "
+                    "serving with the new model instead of hot-swapping"
+                )
             self._variables = variables
             self._step = int(step)
         self._swaps.inc()

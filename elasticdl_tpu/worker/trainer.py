@@ -152,7 +152,10 @@ class Trainer:
         corrupts the CPU backend (see _CPU_EXEC_LOCK)."""
 
         def _replace():
-            host_state = jax.tree.map(
+            # Safe asarray: the view is consumed by device_put inside the
+            # same serialized device operation, so no donating step can
+            # rewrite the buffer while it is live.
+            host_state = jax.tree.map(  # graftlint: disable=GL-DONATE
                 lambda x: np.asarray(x) if hasattr(x, "shape") else x, state
             )
             return jax.device_put(host_state, self.state_sharding(state))

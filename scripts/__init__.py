@@ -1,0 +1,2 @@
+# Makes scripts/ importable so `python -m scripts.graftlint` works from
+# the repo root (the lint shims also import scripts.graftlint.*).

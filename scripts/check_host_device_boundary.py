@@ -1,133 +1,49 @@
 #!/usr/bin/env python
-"""Lint: no device APIs on the host data plane.
+"""Thin shim: the host/device boundary lint now lives in graftlint as
+rule GL-BOUNDARY (scripts/graftlint/rules_boundary.py — see
+docs/LINTS.md).  This entry point keeps the pre-graftlint contract:
+`python scripts/check_host_device_boundary.py` exits 0 on a clean tree
+and 1 with `path:line:`-style findings otherwise, and the detector
+functions stay importable from this file."""
 
-The input pipeline's contract (worker/task_data_service.py,
-docs/PERF.md) is that reader/producer threads touch NUMPY ONLY: they
-read, parse, and pack batches, and every host->device transfer happens
-on the single consumer thread (prefetch_batches' `device_stage` hook,
-Trainer.stage_batch).  Two reasons:
-
-- the virtual multi-device CPU backend used in tests corrupts state
-  under concurrent device execution, so ALL device work funnels through
-  `run_device_serialized` — a device_put on a reader thread bypasses
-  that lock;
-- on real TPU hosts a transfer issued from the producer thread races
-  the training step's own dispatches and serializes the pipeline at
-  the worst point (mid-parse) instead of overlapping with compute.
-
-This lint keeps the boundary honest: in the host-plane files
-(elasticdl_tpu/data/** and worker/task_data_service.py) any use of the
-jax data-movement / device APIs below is an error.  jax.numpy math is
-NOT flagged — device-side unpack helpers (data/wire.py) are traced from
-the consumer's jitted step and never move data themselves.
-
-Exit status: 0 when clean, 1 with one `path:line: message` per finding.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-# data-movement / device-handle APIs that must not appear on the host
-# data plane (reader & producer threads)
-FORBIDDEN_JAX_ATTRS = {
-    "device_put",
-    "device_get",
-    "devices",
-    "local_devices",
-    "make_array_from_callback",
-}
-# method form: any `x.block_until_ready()` implies x is a device array
-FORBIDDEN_METHODS = {"block_until_ready"}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-ALLOWLIST: set = set()
-
-
-def _attr_root(node: ast.Attribute):
-    """The leftmost Name of a dotted attribute chain, or None."""
-    while isinstance(node, ast.Attribute):
-        node = node.value
-    return node.id if isinstance(node, ast.Name) else None
+from scripts.graftlint.core import main as graftlint_main  # noqa: E402
+from scripts.graftlint.rules_boundary import (  # noqa: E402,F401
+    FORBIDDEN_JAX_ATTRS,
+    FORBIDDEN_METHODS,
+    HOST_PLANE_FILES,
+    HOST_PLANE_PREFIXES,
+    RULE_ID,
+    find_device_api_uses,
+)
 
 
-def find_device_api_uses(tree: ast.AST):
-    """Yield (lineno, description) for every device-API use."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute):
-            if node.attr in FORBIDDEN_JAX_ATTRS \
-                    and _attr_root(node) == "jax":
-                yield (
-                    node.lineno,
-                    f"jax.{node.attr} on the host data plane — device "
-                    "transfers belong on the consumer thread "
-                    "(prefetch_batches device_stage / "
-                    "Trainer.stage_batch)",
-                )
-            elif node.attr in FORBIDDEN_METHODS:
-                yield (
-                    node.lineno,
-                    f".{node.attr}() on the host data plane — reader/"
-                    "producer threads must hold numpy arrays only",
-                )
-        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
-            for alias in node.names:
-                if alias.name in FORBIDDEN_JAX_ATTRS:
-                    yield (
-                        node.lineno,
-                        f"`from jax import {alias.name}` on the host "
-                        "data plane — device transfers belong on the "
-                        "consumer thread",
-                    )
-
-
-def check_file(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
-    return list(find_device_api_uses(tree))
-
-
-def host_plane_files(root: str):
-    """The files under the host-plane contract: every module in
-    elasticdl_tpu/data/ plus the prefetch/producer module itself."""
+def host_plane_files(root):
+    """Absolute paths of the host-plane python files under an
+    elasticdl_tpu tree rooted at `root` (the files GL-BOUNDARY scopes
+    to: data/** plus worker/task_data_service.py)."""
+    out = []
     data_dir = os.path.join(root, "data")
-    for dirpath, _dirnames, filenames in os.walk(data_dir):
+    for dirpath, dirnames, filenames in os.walk(data_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in sorted(filenames):
             if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-    yield os.path.join(root, "worker", "task_data_service.py")
+                out.append(os.path.join(dirpath, name))
+    task_data_service = os.path.join(root, "worker", "task_data_service.py")
+    if os.path.exists(task_data_service):
+        out.append(task_data_service)
+    return out
 
 
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "elasticdl_tpu",
-    )
-    findings = []
-    for path in host_plane_files(root):
-        if not os.path.exists(path):
-            continue
-        rel = os.path.relpath(path, os.path.dirname(root))
-        if rel in ALLOWLIST:
-            continue
-        for lineno, message in check_file(path):
-            findings.append(f"{rel}:{lineno}: {message}")
-    for line in findings:
-        print(line)
-    if findings:
-        print(
-            f"{len(findings)} host/device boundary violation(s) found",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
+def main(argv=None):
+    return graftlint_main(["--select", RULE_ID, *(argv or [])])
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -1,273 +1,35 @@
 #!/usr/bin/env python
-"""Lint: registry metrics use literal, `subsystem_name_unit` names, and
-instrumented modules do not grow private counter bookkeeping back.
+"""Thin shim: the metric/event naming lint now lives in graftlint as
+rule GL-METRIC (scripts/graftlint/rules_metrics.py — see docs/LINTS.md).
+This entry point keeps the pre-graftlint contract:
+`python scripts/check_metric_names.py` exits 0 on a clean tree and 1
+with `path:line:`-style findings otherwise, and the detector functions
+stay importable from this file."""
 
-Four rules over elasticdl_tpu/:
-
-1. **Name discipline.**  Every metric-creation call
-   (`*.counter(...)`, `*.gauge(...)`, `*.gauge_fn(...)`,
-   `*.histogram(...)`) must pass its name as a STRING LITERAL that
-   satisfies `common.metrics.validate_metric_name` — a known subsystem
-   prefix and an allowed unit suffix.  Literal-only matters: the
-   registry validates at runtime, but a computed name defeats this lint
-   and makes the metric catalogue (docs/OBSERVABILITY.md) ungreppable.
-   The validator is imported from common/metrics.py, so the lint can
-   never drift from the runtime rules.
-
-2. **No shadow counters.**  In modules already converted to the unified
-   registry (INSTRUMENTED below), a fresh `self.<x> = 0` where `<x>`
-   looks like a counter (`*_count`, `*_total`, `*count`), or a
-   `collections.Counter()` construction, is flagged — those are exactly
-   the private tallies the registry replaced (ISSUE: register, don't
-   rebuild).  Legitimate non-metric state is allowlisted per
-   (module, attribute).
-
-3. **Span-event vocabulary.**  `events.emit(...)` must name its event
-   via a `events.<CONSTANT>` attribute, never a string literal — the
-   constants in common/events.py (and their VOCABULARY set) are the
-   single source of truth the trace exporter (client/trace.py) and
-   docs/OBSERVABILITY.md key on; a stringly-typed event silently falls
-   off every consumer.  common/events.py itself (the definitions) is
-   exempt.
-
-4. **Policy-decision fields.**  Every
-   `emit(events.POLICY_DECISION, ...)` must carry `action=` and
-   `reason=` keyword arguments as STRING LITERALS drawn from the closed
-   POLICY_ACTIONS / POLICY_REASONS vocabularies in common/events.py — a
-   policy decision an operator cannot grep for by exact name never
-   reached the dashboards, and a computed value defeats both this lint
-   and the vocabulary.
-
-Exit status: 0 when clean, 1 with one `path:line: message` per finding.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-from elasticdl_tpu.common.events import (  # noqa: E402
-    POLICY_ACTIONS,
-    POLICY_REASONS,
+from scripts.graftlint.core import main as graftlint_main  # noqa: E402
+from scripts.graftlint.rules_metrics import (  # noqa: E402,F401
+    CREATION_METHODS,
+    DEFAULT_ALLOWLIST,
+    INSTRUMENTED,
+    RULE_ID,
+    find_bad_metric_names,
+    find_shadow_counters,
+    find_stringly_events,
+    find_unlabeled_policy_decisions,
+    literal_metric_name,
 )
-from elasticdl_tpu.common.metrics import validate_metric_name  # noqa: E402
-
-CREATION_METHODS = {"counter", "gauge", "gauge_fn", "histogram"}
-
-# Modules converted to registry-backed counters: shadow-counter rule on.
-INSTRUMENTED = {
-    os.path.join("elasticdl_tpu", "common", "resilience.py"),
-    os.path.join("elasticdl_tpu", "common", "faults.py"),
-    os.path.join("elasticdl_tpu", "serving", "batcher.py"),
-    os.path.join("elasticdl_tpu", "serving", "engine.py"),
-    os.path.join("elasticdl_tpu", "serving", "reloader.py"),
-    os.path.join("elasticdl_tpu", "master", "task_manager.py"),
-    os.path.join("elasticdl_tpu", "master", "pod_manager.py"),
-    os.path.join("elasticdl_tpu", "master", "recovery.py"),
-    os.path.join("elasticdl_tpu", "worker", "worker.py"),
-    os.path.join("elasticdl_tpu", "data", "wire.py"),
-    os.path.join("elasticdl_tpu", "proto", "service.py"),
-}
-
-_SHADOW_ATTR = re.compile(r"(_count$|_total$|count$|_seen$)")
-
-# (module, attribute) pairs that look like counters but are not metrics.
-ALLOWLIST = {
-    # sticky pad caps / last-batch sizes: shapes, not tallies
-    (os.path.join("elasticdl_tpu", "data", "wire.py"), "unique_cap"),
-    (os.path.join("elasticdl_tpu", "data", "wire.py"), "exc_cap"),
-}
 
 
-def _literal_name(call: ast.Call):
-    """The metric name when passed as a literal; None otherwise."""
-    args = call.args
-    if args and isinstance(args[0], ast.Constant) \
-            and isinstance(args[0].value, str):
-        return args[0].value
-    for kw in call.keywords:
-        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
-                and isinstance(kw.value.value, str):
-            return kw.value.value
-    return None
-
-
-def find_bad_metric_names(tree: ast.AST):
-    """Yield (lineno, message) for creation calls with computed or
-    rule-breaking names."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in CREATION_METHODS):
-            continue
-        if not (node.args or node.keywords):
-            continue  # zero-arg call: not a metric creation
-        name = _literal_name(node)
-        if name is None:
-            yield (
-                node.lineno,
-                f"{node.func.attr}(...) metric name must be a string "
-                "literal (computed names defeat this lint and the "
-                "metric catalogue)",
-            )
-            continue
-        error = validate_metric_name(name)
-        if error:
-            yield (node.lineno, f"metric {name!r}: {error}")
-
-
-def find_stringly_events(tree: ast.AST):
-    """Yield (lineno, message) for `emit("...")` calls that bypass the
-    common/events.py constant vocabulary."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "emit"
-                and node.args):
-            continue
-        first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            yield (
-                node.lineno,
-                f"emit({first.value!r}, ...): pass an events.<CONSTANT> "
-                "from common/events.py, not a string literal — the "
-                "vocabulary is what the trace exporter and "
-                "docs/OBSERVABILITY.md key on",
-            )
-
-
-def find_unlabeled_policy_decisions(tree: ast.AST):
-    """Yield (lineno, message) for `emit(events.POLICY_DECISION, ...)`
-    calls missing `action=`/`reason=` string literals from the closed
-    vocabularies in common/events.py."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "emit"
-                and node.args):
-            continue
-        first = node.args[0]
-        if not (isinstance(first, ast.Attribute)
-                and first.attr == "POLICY_DECISION"):
-            continue
-        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
-        for field, vocab in (
-            ("action", POLICY_ACTIONS),
-            ("reason", POLICY_REASONS),
-        ):
-            value = kwargs.get(field)
-            if value is None:
-                yield (
-                    node.lineno,
-                    "emit(events.POLICY_DECISION, ...) must carry "
-                    f"{field}= — a decision without it cannot be "
-                    "grepped off the event stream",
-                )
-            elif not (isinstance(value, ast.Constant)
-                      and isinstance(value.value, str)):
-                yield (
-                    node.lineno,
-                    f"emit(events.POLICY_DECISION, ...): {field}= must "
-                    "be a string literal from the closed vocabulary in "
-                    "common/events.py, not a computed value",
-                )
-            elif value.value not in vocab:
-                yield (
-                    node.lineno,
-                    f"emit(events.POLICY_DECISION, ...): "
-                    f"{field}={value.value!r} is not in the closed "
-                    f"vocabulary {sorted(vocab)}",
-                )
-
-
-def find_shadow_counters(tree: ast.AST):
-    """Yield (lineno, message) for private tallies in instrumented
-    modules: `self.x = 0` counter-shaped attrs and collections.Counter
-    constructions."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            value_is_zero = (
-                isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, int)
-                and not isinstance(node.value.value, bool)
-                and node.value.value == 0
-            )
-            if not value_is_zero:
-                continue
-            for target in node.targets:
-                if (isinstance(target, ast.Attribute)
-                        and isinstance(target.value, ast.Name)
-                        and target.value.id == "self"
-                        and _SHADOW_ATTR.search(target.attr)):
-                    yield (
-                        node.lineno,
-                        f"self.{target.attr} = 0 looks like a private "
-                        "counter — register it on the metrics registry "
-                        "instead (common/metrics.py)",
-                    )
-        elif isinstance(node, ast.Call):
-            func = node.func
-            if (isinstance(func, ast.Attribute)
-                    and func.attr == "Counter"
-                    and isinstance(func.value, ast.Name)
-                    and func.value.id == "collections"):
-                yield (
-                    node.lineno,
-                    "collections.Counter() in an instrumented module — "
-                    "use a labeled registry counter instead",
-                )
-
-
-def check_file(path: str, rel: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
-    findings = list(find_bad_metric_names(tree))
-    if rel != os.path.join("elasticdl_tpu", "common", "events.py"):
-        findings.extend(find_stringly_events(tree))
-    findings.extend(find_unlabeled_policy_decisions(tree))
-    if rel in INSTRUMENTED:
-        findings.extend(
-            (lineno, message)
-            for lineno, message in find_shadow_counters(tree)
-            if not any(
-                rel == mod and f"self.{attr} " in message
-                for mod, attr in ALLOWLIST
-            )
-        )
-    return findings
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.join(REPO, "elasticdl_tpu")
-    findings = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, os.path.dirname(root))
-            for lineno, message in sorted(check_file(path, rel)):
-                findings.append(f"{rel}:{lineno}: {message}")
-    for line in findings:
-        print(line)
-    if findings:
-        print(
-            f"{len(findings)} metric naming/bookkeeping finding(s)",
-            file=sys.stderr,
-        )
-        return 1
-    return 0
+def main(argv=None):
+    return graftlint_main(["--select", RULE_ID, *(argv or [])])
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -1,160 +1,30 @@
 #!/usr/bin/env python
-"""Lint: no naked retry loops in elasticdl_tpu/.
+"""Thin shim: the naked-retry / router-fanout lint now lives in
+graftlint as rule GL-RETRY (scripts/graftlint/rules_retries.py — see
+docs/LINTS.md).  This entry point keeps the pre-graftlint contract:
+`python scripts/check_no_naked_retries.py` exits 0 on a clean tree and
+1 with `path:line:`-style findings otherwise, and the detector
+functions stay importable from this file."""
 
-A "naked retry" is the pattern the unified policy (common/resilience.py)
-exists to replace:
-
-    while True:
-        try:
-            do_rpc()
-        except SomeError:
-            time.sleep(2)   # fixed interval, no jitter, no budget
-
-i.e. an unconditional loop whose exception handler sleeps for a CONSTANT
-interval.  Such loops retry forever with no backoff growth, no jitter (so
-every worker re-hammers the master in lockstep) and no give-up budget (so
-a dead master leaves zombie workers).  New code must route retries through
-`RetryPolicy.call` instead.
-
-Variable-interval sleeps (e.g. `time.sleep(backoff)` with a growing
-`backoff`) are NOT flagged: that is a hand-rolled but bounded backoff, and
-flagging it would force churn in loops that are structurally fine (the
-k8s watch reconnect loop).  The policy's own sleep goes through an
-injected `self._sleep`, so resilience.py passes by construction; it is
-also explicitly allowlisted to stay robust against refactors there.
-
-A second rule covers the serving-fleet router path: in any `*Router`
-class, a PUBLIC method that calls `<replica>.predict(...)` directly must
-also route through `<policy>.call(...)` in its own body — i.e. Predict
-fan-out enters through the unified resilience policy, and the raw
-per-replica sweep stays a private helper the policy wraps
-(proto/service.py FleetRouter is the canonical shape: `predict()` is
-`retry_policy.call(lambda: self._sweep(...))`).  Without this, a future
-"fast path" that fans out to replicas bare would silently lose the
-backoff/budget/failover guarantees docs/SERVING.md promises.
-
-Exit status: 0 when clean, 1 with one `path:line: message` per finding.
-"""
-
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-ALLOWLIST = {os.path.join("elasticdl_tpu", "common", "resilience.py")}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from scripts.graftlint.core import main as graftlint_main  # noqa: E402
+from scripts.graftlint.rules_retries import (  # noqa: E402,F401
+    DEFAULT_ALLOWLIST,
+    RULE_ID,
+    find_naked_retries,
+    find_unguarded_router_fanout,
+)
 
 
-def _is_constant_sleep(node: ast.AST) -> bool:
-    """A call to `sleep`/`*.sleep` with a literal (constant) interval."""
-    if not isinstance(node, ast.Call):
-        return False
-    func = node.func
-    name = (
-        func.attr if isinstance(func, ast.Attribute)
-        else func.id if isinstance(func, ast.Name)
-        else None
-    )
-    if name != "sleep" or not node.args:
-        return False
-    return isinstance(node.args[0], ast.Constant)
-
-
-def _is_unconditional(loop: ast.While) -> bool:
-    return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
-
-
-def find_naked_retries(tree: ast.AST):
-    """Yield (lineno, description) for every while-True loop containing a
-    try whose exception handler sleeps a constant interval."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.While) and _is_unconditional(node)):
-            continue
-        for child in ast.walk(node):
-            if not isinstance(child, ast.Try):
-                continue
-            for handler in child.handlers:
-                for stmt in handler.body:
-                    for sub in ast.walk(stmt):
-                        if _is_constant_sleep(sub):
-                            yield (
-                                sub.lineno,
-                                "fixed-interval sleep in a retry handler "
-                                "inside `while True` — use "
-                                "resilience.RetryPolicy.call instead",
-                            )
-
-
-def _calls_attr(tree: ast.AST, attr: str) -> bool:
-    """True when `tree` contains a call of the form `<x>.<attr>(...)`."""
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == attr):
-            return True
-    return False
-
-
-def find_unguarded_router_fanout(tree: ast.AST):
-    """Yield (lineno, description) for public `*Router` methods that call
-    `.predict(...)` on a replica client without routing through a
-    resilience policy's `.call(...)` in the same method."""
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.ClassDef)
-                and node.name.endswith("Router")):
-            continue
-        for item in node.body:
-            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if item.name.startswith("_"):
-                continue  # private helpers are the policy's wrapped body
-            if _calls_attr(item, "predict") and not _calls_attr(item, "call"):
-                yield (
-                    item.lineno,
-                    f"{node.name}.{item.name} fans Predict out to "
-                    "replicas without resilience.RetryPolicy.call — "
-                    "public router entry points must go through the "
-                    "unified policy (keep the raw sweep in a private "
-                    "helper the policy wraps)",
-                )
-
-
-def check_file(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
-    return list(find_naked_retries(tree)) + list(
-        find_unguarded_router_fanout(tree)
-    )
-
-
-def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    root = argv[0] if argv else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "elasticdl_tpu",
-    )
-    findings = []
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, os.path.dirname(root))
-            if rel in ALLOWLIST:
-                continue
-            for lineno, message in check_file(path):
-                findings.append(f"{rel}:{lineno}: {message}")
-    for line in findings:
-        print(line)
-    if findings:
-        print(f"{len(findings)} naked retry loop(s) found", file=sys.stderr)
-        return 1
-    return 0
+def main(argv=None):
+    return graftlint_main(["--select", RULE_ID, *(argv or [])])
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

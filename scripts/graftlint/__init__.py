@@ -1,0 +1,37 @@
+"""graftlint: the repo's unified AST static-analysis suite.
+
+One parse per file, shared by every registered rule; one `Finding`
+record (`path:line: RULE-ID message`); one entry point
+(`python -m scripts.graftlint`) that CI and tier-1 run.  The rules
+encode invariants this codebase has actually been burned by — see
+docs/LINTS.md for the catalogue (id, rationale, originating bug,
+suppression syntax) and how to add a rule.
+
+Suppressions: append `# graftlint: disable=<rule-id>[,<rule-id>]` to the
+offending line.  Every listed id must name a registered rule, or the
+suppression is itself a finding (GL-SUPPRESS) — dead suppressions must
+not accumulate.
+"""
+
+from scripts.graftlint.core import (  # noqa: F401
+    Finding,
+    ParsedFile,
+    Project,
+    Rule,
+    all_rules,
+    check_source,
+    main,
+    register,
+    run,
+)
+
+# Importing the rule modules registers the default rule instances.
+from scripts.graftlint import (  # noqa: F401,E402
+    rules_boundary,
+    rules_clock,
+    rules_donation,
+    rules_drift,
+    rules_locks,
+    rules_metrics,
+    rules_retries,
+)

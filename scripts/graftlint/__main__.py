@@ -1,0 +1,16 @@
+import os
+import sys
+
+# Ensure the repo root is importable no matter where the module is run
+# from (the rules import elasticdl_tpu.common.* for the shared
+# validators, so lint and runtime can never drift).
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from scripts.graftlint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,345 @@
+"""graftlint core: parsed-file cache, rule registry, runner, CLI.
+
+The framework owns everything rule-agnostic:
+
+- **One parse per file.**  A `ParsedFile` holds the source text, the
+  `ast` tree, and the per-line suppression map; every rule receives the
+  same object, so a seven-rule run costs one `ast.parse` per file (the
+  three pre-graftlint lint scripts each parsed the tree themselves).
+- **Findings.**  `Finding(path, line, rule, message)` renders as
+  `path:line: RULE-ID message` — greppable, editor-clickable, and the
+  shape the acceptance tests assert on.
+- **Suppressions.**  `# graftlint: disable=<rule-id>[,<rule-id>]` on the
+  offending line drops that rule's findings for the line.  A token that
+  names no registered rule is itself a finding (GL-SUPPRESS): dead or
+  typo'd suppressions are the lint-rot this tool exists to prevent.
+- **Selection.**  `--select`/`--ignore` take comma-separated rule ids;
+  unknown ids are a usage error (exit 2), not a silent no-op.
+- **Output.**  Text (default) or `--json`; exit 0 clean / 1 findings.
+
+Framework pseudo-ids (always on, never suppressible): GL-SYNTAX for
+unparseable files, GL-SUPPRESS for bad suppression comments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# The trees `python -m scripts.graftlint` walks by default — the same
+# set the tier-1 "whole repo is clean" test covers.
+DEFAULT_ROOTS = ("elasticdl_tpu", "model_zoo", "scripts")
+
+SYNTAX_ID = "GL-SYNTAX"
+SUPPRESS_ID = "GL-SUPPRESS"
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a repo-relative path and line."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ParsedFile:
+    """One source file, parsed once and shared by every rule."""
+
+    def __init__(self, rel: str, source: str, path: Optional[str] = None):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = path or rel
+        self.source = source
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[Finding] = None
+        try:
+            self.tree = ast.parse(source, filename=self.rel)
+        except SyntaxError as exc:
+            self.syntax_error = Finding(
+                self.rel, exc.lineno or 0, SYNTAX_ID,
+                f"syntax error: {exc.msg}",
+            )
+        # line -> rule ids named by a `# graftlint: disable=` comment
+        self.suppressions: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), 1):
+            match = _SUPPRESS_RE.search(text)
+            if match:
+                ids = {
+                    tok.strip()
+                    for tok in match.group(1).split(",")
+                    if tok.strip()
+                }
+                if ids:
+                    self.suppressions[lineno] = ids
+
+    @classmethod
+    def load(cls, path: str, rel: str) -> "ParsedFile":
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        return cls(rel, raw.decode("utf-8", errors="replace"), path=path)
+
+
+class Project:
+    """The whole scanned tree plus doc-file access for project rules.
+
+    `doc_overrides` maps a repo-relative doc path to replacement text —
+    the hook tests use to prove drift detection without mutating the
+    real docs on disk."""
+
+    def __init__(self, root: str, files: Sequence[ParsedFile],
+                 doc_overrides: Optional[Dict[str, str]] = None):
+        self.root = root
+        self.files = list(files)
+        self._by_rel = {pf.rel: pf for pf in self.files}
+        self._doc_overrides = dict(doc_overrides or {})
+
+    def file(self, rel: str) -> Optional[ParsedFile]:
+        return self._by_rel.get(rel)
+
+    def read_doc(self, rel: str) -> Optional[str]:
+        if rel in self._doc_overrides:
+            return self._doc_overrides[rel]
+        path = os.path.join(self.root, rel)
+        try:
+            with open(path, "rb") as fh:
+                return fh.read().decode("utf-8", errors="replace")
+        except OSError:
+            return None
+
+
+class Rule:
+    """Base rule.  Subclasses set `id`/`title`/`rationale` and override
+    `check` (per file, gated by `applies`) and/or `check_project`
+    (whole-tree rules such as docs drift)."""
+
+    id = ""
+    title = ""
+    rationale = ""
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return True
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if not rule.id:
+        raise ValueError("rule must declare an id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return rule
+
+
+def all_rules() -> Dict[str, Rule]:
+    return dict(_REGISTRY)
+
+
+def _select_rules(select: Optional[Sequence[str]],
+                  ignore: Optional[Sequence[str]]) -> List[Rule]:
+    known = all_rules()
+
+    def _validate(ids):
+        unknown = [i for i in ids if i not in known]
+        if unknown:
+            raise SystemExit(
+                f"graftlint: unknown rule id(s) {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(known))})"
+            )
+
+    chosen = list(known)
+    if select:
+        _validate(select)
+        chosen = [i for i in chosen if i in set(select)]
+    if ignore:
+        _validate(ignore)
+        chosen = [i for i in chosen if i not in set(ignore)]
+    return [known[i] for i in chosen]
+
+
+def discover_files(root: str,
+                   paths: Optional[Sequence[str]] = None) -> List[str]:
+    """Python files under `paths` (files or directories, relative to
+    `root`), defaulting to DEFAULT_ROOTS.  __pycache__ is skipped."""
+    targets = list(paths) if paths else [
+        p for p in DEFAULT_ROOTS if os.path.isdir(os.path.join(root, p))
+    ]
+    out: List[str] = []
+    for target in targets:
+        full = target if os.path.isabs(target) else os.path.join(root, target)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def build_project(root: str = REPO,
+                  paths: Optional[Sequence[str]] = None,
+                  doc_overrides: Optional[Dict[str, str]] = None) -> Project:
+    files = []
+    for path in discover_files(root, paths):
+        rel = os.path.relpath(path, root)
+        files.append(ParsedFile.load(path, rel))
+    return Project(root, files, doc_overrides=doc_overrides)
+
+
+def _suppressed(pf: Optional[ParsedFile], finding: Finding) -> bool:
+    if pf is None:
+        return False
+    return finding.rule in pf.suppressions.get(finding.line, ())
+
+
+def run_project(project: Project,
+                select: Optional[Sequence[str]] = None,
+                ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules over an already-built project and return
+    the surviving (unsuppressed) findings, sorted."""
+    rules = _select_rules(select, ignore)
+    known_ids = set(all_rules())
+    findings: List[Finding] = []
+    for pf in project.files:
+        if pf.syntax_error is not None:
+            findings.append(pf.syntax_error)
+            continue
+        for lineno, ids in sorted(pf.suppressions.items()):
+            for token in sorted(ids - known_ids):
+                findings.append(Finding(
+                    pf.rel, lineno, SUPPRESS_ID,
+                    f"suppression names unknown rule {token!r} — every "
+                    "disable= token must match a registered rule id "
+                    "(see docs/LINTS.md)",
+                ))
+        for rule in rules:
+            if not rule.applies(pf):
+                continue
+            for finding in rule.check(pf):
+                if not _suppressed(pf, finding):
+                    findings.append(finding)
+    for rule in rules:
+        for finding in rule.check_project(project):
+            if not _suppressed(project.file(finding.path), finding):
+                findings.append(finding)
+    return sorted(
+        findings, key=lambda f: (f.path, f.line, f.rule, f.message)
+    )
+
+
+def run(root: str = REPO,
+        paths: Optional[Sequence[str]] = None,
+        select: Optional[Sequence[str]] = None,
+        ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    return run_project(build_project(root, paths), select, ignore)
+
+
+def check_source(source: str, rel: str,
+                 rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run rules over one in-memory source blob (fixture tests).  The
+    `rel` path participates in rule scoping exactly as on disk."""
+    pf = ParsedFile(rel, source)
+    if pf.syntax_error is not None:
+        return [pf.syntax_error]
+    chosen = list(rules) if rules is not None else list(
+        all_rules().values()
+    )
+    out: List[Finding] = []
+    known_ids = set(all_rules())
+    for lineno, ids in sorted(pf.suppressions.items()):
+        for token in sorted(ids - known_ids):
+            out.append(Finding(
+                pf.rel, lineno, SUPPRESS_ID,
+                f"suppression names unknown rule {token!r} — every "
+                "disable= token must match a registered rule id "
+                "(see docs/LINTS.md)",
+            ))
+    for rule in chosen:
+        if rule.applies(pf):
+            out.extend(
+                f for f in rule.check(pf) if not _suppressed(pf, f)
+            )
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def _split_ids(text: Optional[str]) -> Optional[List[str]]:
+    if not text:
+        return None
+    return [tok.strip() for tok in text.split(",") if tok.strip()]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scripts.graftlint",
+        description="Run the repo's static-analysis suite "
+                    "(docs/LINTS.md).",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to scan (default: "
+             + ", ".join(DEFAULT_ROOTS) + ")",
+    )
+    parser.add_argument("--select", help="comma-separated rule ids to run")
+    parser.add_argument("--ignore", help="comma-separated rule ids to skip")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--root", default=REPO,
+                        help="repo root (docs live here; default: "
+                             "autodetected)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id in sorted(all_rules()):
+            rule = all_rules()[rule_id]
+            print(f"{rule_id}: {rule.title}")
+        return 0
+
+    findings = run(
+        root=args.root,
+        paths=args.paths or None,
+        select=_split_ids(args.select),
+        ignore=_split_ids(args.ignore),
+    )
+    if args.as_json:
+        print(json.dumps(
+            {"findings": [f.as_dict() for f in findings],
+             "count": len(findings)},
+            indent=2, sort_keys=True,
+        ))
+    else:
+        for finding in findings:
+            print(finding.format())
+    if findings:
+        print(f"{len(findings)} graftlint finding(s)", file=sys.stderr)
+        return 1
+    return 0

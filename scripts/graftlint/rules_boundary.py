@@ -1,0 +1,117 @@
+"""GL-BOUNDARY: no device APIs on the host data plane.
+
+Migrated from scripts/check_host_device_boundary.py (now a shim).
+
+The input pipeline's contract (worker/task_data_service.py,
+docs/PERF.md) is that reader/producer threads touch NUMPY ONLY: they
+read, parse, and pack batches, and every host->device transfer happens
+on the single consumer thread (prefetch_batches' `device_stage` hook,
+Trainer.stage_batch).  Two reasons:
+
+- the virtual multi-device CPU backend used in tests corrupts state
+  under concurrent device execution, so ALL device work funnels through
+  `run_device_serialized` — a device_put on a reader thread bypasses
+  that lock;
+- on real TPU hosts a transfer issued from the producer thread races
+  the training step's own dispatches and serializes the pipeline at the
+  worst point (mid-parse) instead of overlapping with compute.
+
+In the host-plane files (elasticdl_tpu/data/** and
+worker/task_data_service.py) any use of the jax data-movement / device
+APIs below is an error.  jax.numpy math is NOT flagged — device-side
+unpack helpers (data/wire.py) are traced from the consumer's jitted
+step and never move data themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-BOUNDARY"
+
+# data-movement / device-handle APIs that must not appear on the host
+# data plane (reader & producer threads)
+FORBIDDEN_JAX_ATTRS = {
+    "device_put",
+    "device_get",
+    "devices",
+    "local_devices",
+    "make_array_from_callback",
+}
+# method form: any `x.block_until_ready()` implies x is a device array
+FORBIDDEN_METHODS = {"block_until_ready"}
+
+HOST_PLANE_PREFIXES = ("elasticdl_tpu/data/",)
+HOST_PLANE_FILES = frozenset({
+    "elasticdl_tpu/worker/task_data_service.py",
+})
+
+
+def _attr_root(node: ast.Attribute):
+    """The leftmost Name of a dotted attribute chain, or None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def find_device_api_uses(tree: ast.AST):
+    """Yield (lineno, description) for every device-API use.  (Public:
+    the check_host_device_boundary.py shim re-exports this.)"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute):
+            if node.attr in FORBIDDEN_JAX_ATTRS \
+                    and _attr_root(node) == "jax":
+                yield (
+                    node.lineno,
+                    f"jax.{node.attr} on the host data plane — device "
+                    "transfers belong on the consumer thread "
+                    "(prefetch_batches device_stage / "
+                    "Trainer.stage_batch)",
+                )
+            elif node.attr in FORBIDDEN_METHODS:
+                yield (
+                    node.lineno,
+                    f".{node.attr}() on the host data plane — reader/"
+                    "producer threads must hold numpy arrays only",
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name in FORBIDDEN_JAX_ATTRS:
+                    yield (
+                        node.lineno,
+                        f"`from jax import {alias.name}` on the host "
+                        "data plane — device transfers belong on the "
+                        "consumer thread",
+                    )
+
+
+class BoundaryRule(Rule):
+    id = RULE_ID
+    title = "no jax device APIs on the host data plane"
+    rationale = (
+        "a device_put on a reader thread bypasses run_device_serialized "
+        "(CPU-backend corruption) and serializes the TPU pipeline "
+        "mid-parse"
+    )
+
+    def __init__(self, allowlist: FrozenSet[str] = frozenset()):
+        # repo-relative paths exempt from the host-plane contract
+        self.allowlist = frozenset(allowlist)
+
+    def applies(self, pf: ParsedFile) -> bool:
+        if pf.rel in self.allowlist:
+            return False
+        return (
+            pf.rel.startswith(HOST_PLANE_PREFIXES)
+            or pf.rel in HOST_PLANE_FILES
+        )
+
+    def check(self, pf: ParsedFile):
+        for lineno, message in find_device_api_uses(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(BoundaryRule())

@@ -1,0 +1,132 @@
+"""GL-CLOCK: clock discipline — modules that declare an injectable
+clock must never read the wall clock directly.
+
+The control-plane loops (master/task_manager.py, master/recovery.py,
+master/policy.py, master/serving_fleet.py, serving/batcher.py,
+common/resilience.py) take an injectable `clock` callable precisely so
+the chaos soaks and policy tests can replay deterministically under a
+fake clock (docs/ROBUSTNESS.md "Determinism").  One stray
+`time.time()` in such a module silently mixes wall time into the fake
+timeline: dwell/lease/backoff math compares fake seconds against real
+seconds, the soak stops being byte-stable across runs, and the failure
+only shows up as flaky chaos tests.
+
+The rule: in any module that declares a `clock` (or `now_fn`)
+parameter, every direct `time.time()` / `time.monotonic()` CALL is a
+finding.  The clock's default factory itself (`clock: Callable =
+time.time` or a default-expression lambda) is exempt — a default
+REFERENCE is how the injection point is declared; a call anywhere else
+bypasses it.
+
+Escapes: route the read through the injected clock, or allowlist
+(path, enclosing-function) with a one-line justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Tuple
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-CLOCK"
+
+CLOCK_PARAM_NAMES = ("clock", "now_fn")
+WALL_CLOCK_ATTRS = ("time", "monotonic")
+
+# (path, enclosing function) pairs where a direct wall-clock read is
+# deliberate; each needs a one-line justification where it is added.
+DEFAULT_ALLOWLIST: FrozenSet[Tuple[str, str]] = frozenset()
+
+
+def _clock_declarations(tree: ast.AST):
+    """FunctionDefs that declare an injectable clock parameter."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = list(node.args.args) + list(node.args.kwonlyargs)
+            if any(p.arg in CLOCK_PARAM_NAMES for p in params):
+                yield node
+
+
+def declares_injectable_clock(tree: ast.AST) -> bool:
+    for _ in _clock_declarations(tree):
+        return True
+    return False
+
+
+def _default_expr_nodes(tree: ast.AST):
+    """ids of AST nodes inside function-parameter default expressions —
+    the one place a wall-clock factory may legitimately appear."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for default in defaults:
+                for sub in ast.walk(default):
+                    out.add(id(sub))
+    return out
+
+
+def find_naked_clock_reads(tree: ast.AST):
+    """Yield (lineno, message, enclosing_function) for every direct
+    `time.time()` / `time.monotonic()` call in a clock-declaring module,
+    outside parameter defaults."""
+    if not declares_injectable_clock(tree):
+        return
+    exempt = _default_expr_nodes(tree)
+    # map call -> innermost enclosing function name, via a stack walk
+    enclosing: Dict[int, str] = {}
+
+    def _walk(node, fn_name):
+        for child in ast.iter_child_nodes(node):
+            name = fn_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            enclosing[id(child)] = name
+            _walk(child, name)
+
+    _walk(tree, "<module>")
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in WALL_CLOCK_ATTRS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time"):
+            continue
+        if id(node) in exempt:
+            continue
+        yield (
+            node.lineno,
+            f"time.{node.func.attr}() in a module that declares an "
+            "injectable clock — read the injected clock instead, or "
+            "the deterministic fake-clock chaos/policy tests silently "
+            "mix wall time into their timeline",
+            enclosing.get(id(node), "<module>"),
+        )
+
+
+class ClockRule(Rule):
+    id = RULE_ID
+    title = "no wall-clock reads in injectable-clock modules"
+    rationale = (
+        "fake-clock chaos soaks are only deterministic while every "
+        "timestamp in the module flows through the injected clock"
+    )
+
+    def __init__(
+        self,
+        allowlist: FrozenSet[Tuple[str, str]] = DEFAULT_ALLOWLIST,
+    ):
+        self.allowlist = frozenset(allowlist)
+
+    def check(self, pf: ParsedFile):
+        for lineno, message, fn_name in find_naked_clock_reads(pf.tree):
+            if (pf.rel, fn_name) in self.allowlist:
+                continue
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(ClockRule())

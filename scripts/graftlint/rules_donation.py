@@ -1,0 +1,194 @@
+"""GL-DONATE: donation-aliasing — no zero-copy host views of buffers a
+donating step may rewrite.
+
+The originating bug (PR 5 root-cause, tests/test_remesh.py): on the CPU
+backend `np.asarray(device_array)` can return a zero-copy VIEW of the
+device buffer.  A later `jit(..., donate_argnums=...)` step hands that
+buffer back to XLA for reuse and silently rewrites the "snapshot" in
+place — the restore under test was always right; the reference copy was
+corrupt.  The owning-copy helper is
+`parallel/collectives.host_snapshot()` (`np.array(x, copy=True)`).
+
+This rule makes that a machine-checked class: in any module that uses
+`donate_argnums`, the following are findings when applied to
+state-shaped values (identifiers containing `state`/`params`/`weights`/
+`buffers` — the donated train-state trees):
+
+- `np.asarray(<state>)` / `numpy.asarray(<state>)`
+- `<state>.view(...)`
+- `jax.tree.map(f, <state>)` (also `tree_map`) where `f` mentions
+  `asarray` or `.view` — the tree-mapped form the bug actually shipped
+  as.
+
+Escapes: a `# graftlint: disable=GL-DONATE` line suppression for sites
+that re-place or serialize the view before any step can run (say why),
+or the rule's (path, identifier) allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import FrozenSet, Tuple
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-DONATE"
+
+# Identifier tokens that name (parts of) the donated train-state trees.
+STATE_TOKEN_RE = re.compile(
+    r"(^|_)(state|params|weights|buffers)(_|$)"
+)
+
+DEFAULT_ALLOWLIST: FrozenSet[Tuple[str, str]] = frozenset()
+
+
+def module_uses_donation(tree: ast.AST) -> bool:
+    """True when any call in the module passes a `donate_argnums=`
+    keyword (jax.jit / pjit)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.keyword) and node.arg == "donate_argnums":
+            return True
+    return False
+
+
+def _identifier_tokens(node: ast.AST):
+    """Identifier parts of an expression worth matching against the
+    state vocabulary: names and attribute components, descending through
+    subscripts (`state.params`, `self._state`, `trees["params"]`)."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            yield node.attr
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                yield node.slice.value
+            node = node.value
+        elif isinstance(node, ast.Name):
+            yield node.id
+            return
+        else:
+            return
+
+
+def _state_token(node: ast.AST):
+    """The first state-vocabulary identifier in `node`, or None."""
+    for token in _identifier_tokens(node):
+        if token == "self":
+            continue
+        if STATE_TOKEN_RE.search(token):
+            return token
+    return None
+
+
+def _is_asarray(func: ast.AST) -> bool:
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "asarray"
+        and isinstance(func.value, ast.Name)
+        and func.value.id in ("np", "numpy")
+    )
+
+
+def _is_tree_map(func: ast.AST) -> bool:
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr == "tree_map":
+        return True
+    return (
+        func.attr == "map"
+        and isinstance(func.value, ast.Attribute)
+        and func.value.attr == "tree"
+    )
+
+
+def _mentions_aliasing(fn: ast.AST) -> bool:
+    """True when the mapped callable mentions `asarray` or `.view` —
+    called or passed by reference."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "asarray", "view",
+        ):
+            return True
+        if isinstance(node, ast.Name) and node.id == "asarray":
+            return True
+    return False
+
+
+def find_donation_aliasing(tree: ast.AST):
+    """Yield (lineno, message, identifier) for host-view creations over
+    state-shaped values in a donating module."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if _is_asarray(func) and node.args:
+            token = _state_token(node.args[0])
+            if token is not None:
+                yield (
+                    node.lineno,
+                    f"np.asarray over {token!r} can be a zero-copy view "
+                    "of a buffer a later donate_argnums step rewrites "
+                    "in place (the PR 5 checkpoint-corruption class) — "
+                    "use parallel/collectives.host_snapshot() for an "
+                    "owning copy",
+                    token,
+                )
+        elif (isinstance(func, ast.Attribute) and func.attr == "view"
+              and not node.args and not node.keywords):
+            token = _state_token(func.value)
+            if token is not None:
+                yield (
+                    node.lineno,
+                    f".view() over {token!r} aliases a buffer a later "
+                    "donate_argnums step may rewrite in place — use "
+                    "parallel/collectives.host_snapshot() for an "
+                    "owning copy",
+                    token,
+                )
+        elif _is_tree_map(func) and len(node.args) >= 2:
+            if not _mentions_aliasing(node.args[0]):
+                continue
+            for tree_arg in node.args[1:]:
+                token = _state_token(tree_arg)
+                if token is not None:
+                    yield (
+                        node.lineno,
+                        f"tree-mapping asarray/.view over {token!r} "
+                        "builds zero-copy views of buffers a later "
+                        "donate_argnums step rewrites in place (the "
+                        "PR 5 corruption) — use "
+                        "parallel/collectives.host_snapshot() for an "
+                        "owning copy",
+                        token,
+                    )
+                    break
+
+
+class DonationRule(Rule):
+    id = RULE_ID
+    title = "no zero-copy host views of donated device buffers"
+    rationale = (
+        "np.asarray over a donated buffer silently corrupts the host "
+        "'snapshot' when the next step runs (PR 5 test_remesh "
+        "root-cause); host_snapshot() is the owning-copy helper"
+    )
+
+    def __init__(
+        self,
+        allowlist: FrozenSet[Tuple[str, str]] = DEFAULT_ALLOWLIST,
+    ):
+        # (repo-relative path, state identifier) pairs proven benign
+        self.allowlist = frozenset(allowlist)
+
+    def check(self, pf: ParsedFile):
+        if not module_uses_donation(pf.tree):
+            return
+        for lineno, message, token in find_donation_aliasing(pf.tree):
+            if (pf.rel, token) in self.allowlist:
+                continue
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(DonationRule())

@@ -1,0 +1,243 @@
+"""GL-LOCK: lock discipline — a heuristic race detector for
+lock-owning classes.
+
+The control plane is daemon threads sharing state: the batcher's
+admission queue, the telemetry server reading component registries, the
+fleet manager's probe loop, the policy engine's tick.  The compiler
+cannot help; the convention that protects these classes is "every
+access to shared mutable state goes through `with self._lock`".  This
+rule flags the places where the convention is half-applied — exactly
+the shape real races ship as:
+
+For every class that OWNS a lock (`self.X = threading.Lock()` /
+`RLock()` / `Condition()` in its body), any instance attribute that is
+**written under the lock in one method but read or written without it
+elsewhere** is a finding, anchored at the unlocked access.
+
+What counts as "under the lock":
+
+- lexically inside a `with self.<lock>:` block;
+- anywhere in a method whose name ends `_locked` (the repo convention
+  for "caller holds the lock" — serving_fleet's `_relaunch_locked`);
+- anywhere in a PRIVATE method whose every intra-class call site is
+  itself under the lock (computed to a fixpoint) — helpers like
+  `_maybe_checkpoint` that only run from locked public methods.
+
+`__init__`/`__new__` are ignored entirely: construction happens before
+the object is shared.
+
+Escapes, for the genuinely-benign cases (GIL-atomic scalar reads on
+telemetry paths, immutable-after-init config): the per-(class, attr)
+allowlist below — every entry carries a one-line justification — or a
+`# graftlint: disable=GL-LOCK` line suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-LOCK"
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+INIT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+# (class name, attribute) -> one-line justification.  Keep these
+# honest: an entry without a reason is a future race.
+DEFAULT_ALLOWLIST: Dict[Tuple[str, str], str] = {}
+
+
+class _Access:
+    __slots__ = ("attr", "lineno", "is_write", "under", "method")
+
+    def __init__(self, attr, lineno, is_write, under, method):
+        self.attr = attr
+        self.lineno = lineno
+        self.is_write = is_write
+        self.under = under
+        self.method = method
+
+
+def _lock_attrs(cls: ast.ClassDef):
+    """Names X for `self.X = threading.Lock()/RLock()/Condition(...)`
+    anywhere in the class body."""
+    out = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in LOCK_FACTORIES
+                and isinstance(value.func.value, ast.Name)
+                and value.func.value.id == "threading"):
+            continue
+        for target in node.targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                out.add(target.attr)
+    return out
+
+
+def _is_self_lock(expr: ast.AST, lock_attrs) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in lock_attrs
+    )
+
+
+def _scan_method(method, lock_attrs, method_names,
+                 accesses: List[_Access],
+                 calls: List[Tuple[str, bool]]) -> None:
+    """Collect self.<attr> accesses (with their under-lock flag) and
+    intra-class self.<method>() call sites from one method body."""
+
+    locked_whole = method.name.endswith("_locked")
+
+    def visit(node, under):
+        if isinstance(node, ast.With):
+            body_under = under or any(
+                _is_self_lock(item.context_expr, lock_attrs)
+                for item in node.items
+            )
+            for item in node.items:
+                visit(item, under)
+            for stmt in node.body:
+                visit(stmt, body_under)
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            attr = node.attr
+            if attr not in lock_attrs and not attr.startswith("__"):
+                if attr in method_names:
+                    calls.append((attr, under))
+                else:
+                    is_write = isinstance(
+                        node.ctx, (ast.Store, ast.Del)
+                    )
+                    accesses.append(_Access(
+                        attr, node.lineno, is_write, under, method.name
+                    ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, under)
+
+    for stmt in method.body:
+        visit(stmt, locked_whole)
+
+
+def find_lock_discipline(
+    cls: ast.ClassDef,
+) -> List[Tuple[int, str, str]]:
+    """[(lineno, message, attr)] for one class: attributes written under
+    the class's lock in one place but accessed outside it elsewhere."""
+    lock_attrs = _lock_attrs(cls)
+    if not lock_attrs:
+        return []
+    methods = [
+        node for node in cls.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    method_names = {m.name for m in methods}
+
+    per_method_accesses: Dict[str, List[_Access]] = {}
+    call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for method in methods:
+        if method.name in INIT_METHODS:
+            continue
+        accesses: List[_Access] = []
+        calls: List[Tuple[str, bool]] = []
+        _scan_method(method, lock_attrs, method_names, accesses, calls)
+        per_method_accesses[method.name] = accesses
+        for callee, under in calls:
+            call_sites.setdefault(callee, []).append((method.name, under))
+
+    # Fixpoint: a private helper whose every intra-class call site is
+    # under the lock runs under the lock itself.
+    under_methods = {m.name for m in methods if m.name.endswith("_locked")}
+    changed = True
+    while changed:
+        changed = False
+        for method in methods:
+            name = method.name
+            if name in under_methods or not name.startswith("_"):
+                continue
+            sites = call_sites.get(name)
+            if not sites:
+                continue
+            if all(
+                under or caller in under_methods
+                for caller, under in sites
+            ):
+                under_methods.add(name)
+                changed = True
+
+    def effective_under(access: _Access) -> bool:
+        return access.under or access.method in under_methods
+
+    locked_writes: Dict[str, _Access] = {}
+    for accesses in per_method_accesses.values():
+        for access in accesses:
+            if access.is_write and effective_under(access):
+                existing = locked_writes.get(access.attr)
+                if existing is None or access.lineno < existing.lineno:
+                    locked_writes[access.attr] = access
+
+    findings: List[Tuple[int, str, str]] = []
+    for attr in sorted(locked_writes):
+        write = locked_writes[attr]
+        unlocked = [
+            access
+            for accesses in per_method_accesses.values()
+            for access in accesses
+            if access.attr == attr and not effective_under(access)
+        ]
+        if not unlocked:
+            continue
+        first = min(unlocked, key=lambda a: a.lineno)
+        kind = "written" if first.is_write else "read"
+        findings.append((
+            first.lineno,
+            f"{cls.name}.{attr} is written under the lock "
+            f"({write.method}:{write.lineno}) but {kind} without it in "
+            f"{first.method} — take the lock, or allowlist "
+            f"({cls.name!r}, {attr!r}) with a justification in "
+            "scripts/graftlint/rules_locks.py",
+            attr,
+        ))
+    return findings
+
+
+class LockRule(Rule):
+    id = RULE_ID
+    title = "lock discipline: no unlocked access to lock-guarded state"
+    rationale = (
+        "half-applied locking is how control-plane races ship: the "
+        "attribute is guarded where it was first written and bare in "
+        "the method added later"
+    )
+
+    def __init__(
+        self,
+        allowlist: Optional[Dict[Tuple[str, str], str]] = None,
+    ):
+        self.allowlist = dict(
+            DEFAULT_ALLOWLIST if allowlist is None else allowlist
+        )
+
+    def check(self, pf: ParsedFile):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for lineno, message, attr in find_lock_discipline(node):
+                if (node.name, attr) in self.allowlist:
+                    continue
+                yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(LockRule())

@@ -1,0 +1,141 @@
+"""GL-RETRY: no naked retry loops; router fan-out goes through the
+unified resilience policy.
+
+Migrated from scripts/check_no_naked_retries.py (now a shim).
+
+A "naked retry" is the pattern the unified policy (common/resilience.py)
+exists to replace:
+
+    while True:
+        try:
+            do_rpc()
+        except SomeError:
+            time.sleep(2)   # fixed interval, no jitter, no budget
+
+Such loops retry forever with no backoff growth, no jitter (so every
+worker re-hammers the master in lockstep) and no give-up budget (so a
+dead master leaves zombie workers).  Variable-interval sleeps (e.g.
+`time.sleep(backoff)` with a growing `backoff`) are NOT flagged: that is
+a hand-rolled but bounded backoff (the k8s watch reconnect loop).
+
+The second pattern covers the serving-fleet router path: in any
+`*Router` class, a PUBLIC method that calls `<replica>.predict(...)`
+directly must also route through `<policy>.call(...)` in its own body —
+Predict fan-out enters through the unified resilience policy, and the
+raw per-replica sweep stays a private helper the policy wraps
+(proto/service.py FleetRouter is the canonical shape).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-RETRY"
+
+# The policy's own sleep goes through an injected `self._sleep`, so
+# resilience.py passes by construction; it is also explicitly
+# allowlisted to stay robust against refactors there.
+DEFAULT_ALLOWLIST = frozenset({"elasticdl_tpu/common/resilience.py"})
+
+
+def _is_constant_sleep(node: ast.AST) -> bool:
+    """A call to `sleep`/`*.sleep` with a literal (constant) interval."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = (
+        func.attr if isinstance(func, ast.Attribute)
+        else func.id if isinstance(func, ast.Name)
+        else None
+    )
+    if name != "sleep" or not node.args:
+        return False
+    return isinstance(node.args[0], ast.Constant)
+
+
+def _is_unconditional(loop: ast.While) -> bool:
+    return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+
+
+def find_naked_retries(tree: ast.AST):
+    """Yield (lineno, description) for every while-True loop containing a
+    try whose exception handler sleeps a constant interval.  (Public:
+    the check_no_naked_retries.py shim re-exports this.)"""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.While) and _is_unconditional(node)):
+            continue
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Try):
+                continue
+            for handler in child.handlers:
+                for stmt in handler.body:
+                    for sub in ast.walk(stmt):
+                        if _is_constant_sleep(sub):
+                            yield (
+                                sub.lineno,
+                                "fixed-interval sleep in a retry handler "
+                                "inside `while True` — use "
+                                "resilience.RetryPolicy.call instead",
+                            )
+
+
+def _calls_attr(tree: ast.AST, attr: str) -> bool:
+    """True when `tree` contains a call of the form `<x>.<attr>(...)`."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            return True
+    return False
+
+
+def find_unguarded_router_fanout(tree: ast.AST):
+    """Yield (lineno, description) for public `*Router` methods that call
+    `.predict(...)` on a replica client without routing through a
+    resilience policy's `.call(...)` in the same method.  (Public: the
+    check_no_naked_retries.py shim re-exports this.)"""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Router")):
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name.startswith("_"):
+                continue  # private helpers are the policy's wrapped body
+            if _calls_attr(item, "predict") and not _calls_attr(item, "call"):
+                yield (
+                    item.lineno,
+                    f"{node.name}.{item.name} fans Predict out to "
+                    "replicas without resilience.RetryPolicy.call — "
+                    "public router entry points must go through the "
+                    "unified policy (keep the raw sweep in a private "
+                    "helper the policy wraps)",
+                )
+
+
+class RetryRule(Rule):
+    id = RULE_ID
+    title = "no naked retry loops; router fan-out through RetryPolicy"
+    rationale = (
+        "fixed-interval forever-retries re-hammer a recovering master in "
+        "lockstep and leave zombie workers when it never comes back"
+    )
+
+    def __init__(self, allowlist: FrozenSet[str] = DEFAULT_ALLOWLIST):
+        self.allowlist = frozenset(allowlist)
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return pf.rel not in self.allowlist
+
+    def check(self, pf: ParsedFile):
+        for lineno, message in find_naked_retries(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+        for lineno, message in find_unguarded_router_fanout(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(RetryRule())

@@ -1,8 +1,14 @@
 #!/usr/bin/env bash
-# CI entry point (SURVEY.md C23 parity): unit + in-process integration
-# tests on a virtual 8-device CPU mesh, then the native-component build.
+# CI entry point (SURVEY.md C23 parity): static analysis first (fast,
+# no device), then unit + in-process integration tests on a virtual
+# 8-device CPU mesh, then the native-component build.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# The single lint gate: all seven graftlint rules in one process
+# (docs/LINTS.md).  The legacy check_*.py scripts remain as shims over
+# the same rules, so running them separately here would be redundant.
+python -m scripts.graftlint
 
 make -C native
 python -m pytest tests/ -q "$@"
