@@ -73,6 +73,46 @@ def _cost(compiled) -> dict:
     return dict(analysis or {})
 
 
+def _arena_bytes_per_step(
+    batch_size: int,
+    vocab_capacity: int,
+    embed_dim: int,
+    arena_dtype: str,
+    n_fields: int = 26,
+) -> dict:
+    """Analytic bytes the ARENA PLANES contribute to one DeepFM train
+    step, from capacity/dim/dtype alone — the attributable counterpart
+    to the XLA cost-model total (which mixes in MLP/FM traffic and
+    fusion estimates).  Per table (embed_dim-wide + the dim-1 linear):
+
+    - gather plane: n_ids rows x dim x itemsize (1 byte int8 / 4 fp32),
+      plus a 4-byte per-row scale read in int8 mode.  This is the
+      RANDOM-ACCESS plane — the memory-wall term int8 exists to shrink;
+    - scatter plane: the backward writes an fp32 zeros gradient table
+      (capacity x dim x 4) and scatter-adds n_ids fp32 rows — identical
+      in both modes (the gradient/optimizer path stays fp32);
+    - int8 write-back fold: re-reads and re-writes the full code +
+      scale planes (2 x capacity x (dim + 4)) — SEQUENTIAL streaming,
+      cheap per byte next to the gather's random access, but it makes
+      the int8 train-step TOTAL larger at small batch.  The gather
+      component is the like-for-like reduction figure (and the whole
+      story for serving, which runs no fold).
+    """
+    n_ids = batch_size * n_fields
+    out = {"gather": 0, "scatter": 0, "fold": 0}
+    for dim in (embed_dim, 1):  # fm_embedding + fm_linear
+        item = 1 if arena_dtype == "int8" else 4
+        gather = n_ids * dim * item
+        if arena_dtype == "int8":
+            gather += n_ids * 4  # per-row scale read
+        out["gather"] += gather
+        out["scatter"] += vocab_capacity * dim * 4 + n_ids * dim * 4
+        if arena_dtype == "int8":
+            out["fold"] += 2 * vocab_capacity * (dim + 4)
+    out["total"] = out["gather"] + out["scatter"] + out["fold"]
+    return out
+
+
 def _make_criteo_batch(batch_size: int):
     rng = np.random.RandomState(0)
     return {
@@ -90,7 +130,11 @@ def _make_criteo_batch(batch_size: int):
     }
 
 
-def _deepfm_auc(steps: int = 32, batch_size: int = 4096) -> float:
+def _deepfm_auc(
+    steps: int = 32,
+    batch_size: int = 4096,
+    arena_dtype: str = "float32",
+) -> float:
     """Short convergence run with planted structure (BASELINE.md: steps/sec
     only counts *at matching AUC*; this proves the measured step learns)."""
     import jax
@@ -100,7 +144,10 @@ def _deepfm_auc(steps: int = 32, batch_size: int = 4096) -> float:
 
     spec, trainer = _trainer_for(
         "deepfm.deepfm_functional_api.custom_model",
-        model_params="vocab_capacity=1048576;embed_dim=16;bf16=True;lr=0.005",
+        model_params=(
+            "vocab_capacity=1048576;embed_dim=16;bf16=True;lr=0.005;"
+            f"arena_dtype='{arena_dtype}'"
+        ),
         use_bf16=True,
     )
     dense, sparse, labels = synthetic_criteo(steps * batch_size, seed=0)
@@ -122,14 +169,15 @@ def _deepfm_auc(steps: int = 32, batch_size: int = 4096) -> float:
     return float(auc_fn(vy, preds))
 
 
-def bench_deepfm(iters: int = 30):
+def bench_deepfm(iters: int = 30, arena_dtype: str = "float32"):
     """North-star bench (BASELINE.md #4): DeepFM/Criteo sparse stress.
 
     bf16 MLP compute (params f32), batch-size sweep for the headline, XLA
     cost-model MFU + HBM utilisation, an embedding-gather roofline probe
     (the step is gather-bound by design — SURVEY.md hard part 2), and AUC
     from a short convergence run so the steps/sec number is of a step that
-    demonstrably learns."""
+    demonstrably learns.  `arena_dtype="int8"` runs the same bench with
+    quantized embedding storage (ISSUE 9) — dispatch key `deepfm-int8`."""
     import jax
     import jax.numpy as jnp
 
@@ -137,7 +185,10 @@ def bench_deepfm(iters: int = 30):
 
     spec, trainer = _trainer_for(
         "deepfm.deepfm_functional_api.custom_model",
-        model_params="vocab_capacity=1048576;embed_dim=16;bf16=True",
+        model_params=(
+            "vocab_capacity=1048576;embed_dim=16;bf16=True;"
+            f"arena_dtype='{arena_dtype}'"
+        ),
         use_bf16=True,
     )
     peaks = _device_peaks()
@@ -200,12 +251,19 @@ def bench_deepfm(iters: int = 30):
         "embed_dim": 16,
         "compute_dtype": "bfloat16",
         "param_dtype": "float32",
+        "arena_dtype": arena_dtype,
         "device": str(jax.devices()[0]),
         "step_flops_xla": flops,
         # XLA cost-model operand bytes: an upper bound on logical access,
         # NOT physical HBM traffic (fusion/VMEM reuse make it exceed the
         # HBM roof) — recorded for step-to-step comparison only.
         "step_bytes_accessed_xla_costmodel": bytes_accessed,
+        # Analytic arena-plane traffic (gather + scatter + int8 fold),
+        # from capacity/dim/dtype — the attributable slice of the number
+        # above; see _arena_bytes_per_step for the formula.
+        "arena_bytes_per_step": _arena_bytes_per_step(
+            batch_size, 1 << 20, 16, arena_dtype
+        ),
     }
     if flops:
         detail["achieved_tflops"] = round(flops * steps_per_sec / 1e12, 2)
@@ -246,7 +304,9 @@ def bench_deepfm(iters: int = 30):
         gather_s * 1e3, 3
     )
 
-    detail["auc_synthetic_criteo"] = round(_deepfm_auc(), 4)
+    detail["auc_synthetic_criteo"] = round(
+        _deepfm_auc(arena_dtype=arena_dtype), 4
+    )
     detail["timing_method"] = (
         "fused on-device fori_loop, step-counter + params-anchor "
         "outputs, value-fetch synced.  The anchor matters: without a "
@@ -1117,13 +1177,27 @@ def bench_sparse_path(batch_size: int = 65536):
                 )(ids[:, i]).sum()
             return total
 
+    class _ArenaToyQ(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            vecs = EmbeddingArena(
+                feats, dim, name="arena", arena_dtype="int8"
+            )({f"f{i}": ids[:, i] for i in range(n_feat)})
+            return sum(v.sum() for v in vecs.values())
+
     def kernel_counts(model):
         import re
 
-        params = model.init(jax.random.PRNGKey(0), toy_ids)
+        variables = model.init(jax.random.PRNGKey(0), toy_ids)
+        params = {"params": variables["params"]}
+        # non-params collections (the int8 code/scale planes) ride as
+        # constants: they are integer storage, not differentiable leaves
+        rest = {k: v for k, v in variables.items() if k != "params"}
 
         def step(p, ids):
-            return jax.value_and_grad(lambda q: model.apply(q, ids))(p)
+            return jax.value_and_grad(
+                lambda q: model.apply({**q, **rest}, ids)
+            )(p)
 
         # count in the lowered StableHLO (what XLA receives): the CPU
         # backend expands scatters into while loops post-optimization,
@@ -1138,6 +1212,84 @@ def bench_sparse_path(batch_size: int = 65536):
         "features": n_feat,
         "per_feature_tables": kernel_counts(_PerFeatureToy()),
         "fused_arena": kernel_counts(_ArenaToy()),
+        # int8 storage keeps the fused shape: one code gather + one
+        # scale gather + one scatter-add, independent of feature count
+        "fused_arena_int8": kernel_counts(_ArenaToyQ()),
+    }
+
+    # Quantized-vs-fp32 economics (ISSUE 9): the headline DeepFM config
+    # in both arena storage modes — examples/s, XLA cost-model bytes,
+    # the analytic arena-plane bytes, and the AUC delta from the short
+    # convergence run.  int8 shrinks the gather plane ~4x (1-byte codes
+    # + a per-row fp32 scale vs 4-byte rows) while gradients and the
+    # optimizer stay fp32 — see docs/PERF.md "Quantized arena".
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+
+    qb = min(batch_size, 16384)
+    qbatch = _make_criteo_batch(qb)
+    modes = {}
+    for dtype in ("float32", "int8"):
+        _, trainer = _trainer_for(
+            "deepfm.deepfm_functional_api.custom_model",
+            model_params=(
+                "vocab_capacity=1048576;embed_dim=16;bf16=True;"
+                f"arena_dtype='{dtype}'"
+            ),
+            use_bf16=True,
+        )
+        state = trainer.init_state(
+            jax.random.PRNGKey(0), qbatch["features"]
+        )
+        sps = sorted(
+            trainer.timed_steps_per_sec_fused(state, qbatch, iters=8)
+            for _ in range(3)
+        )[1]
+        sharded = mesh_lib.shard_batch(qbatch, trainer.mesh)
+        cost = _cost(trainer.train_step.lower(state, sharded).compile())
+        modes[dtype] = {
+            "examples_per_sec": round(sps * qb, 1),
+            "step_bytes_accessed_xla_costmodel": float(
+                cost.get("bytes accessed", 0.0)
+            ),
+            "arena_bytes_per_step": _arena_bytes_per_step(
+                qb, 1 << 20, 16, dtype
+            ),
+            "auc_synthetic_criteo": round(
+                _deepfm_auc(arena_dtype=dtype), 4
+            ),
+        }
+    f32, i8 = modes["float32"], modes["int8"]
+    detail["quantized_vs_fp32"] = {
+        "batch_size": qb,
+        **modes,
+        "examples_per_sec_speedup_int8": round(
+            i8["examples_per_sec"] / max(f32["examples_per_sec"], 1e-9), 3
+        ),
+        "bytes_accessed_reduction_xla": round(
+            1
+            - i8["step_bytes_accessed_xla_costmodel"]
+            / max(f32["step_bytes_accessed_xla_costmodel"], 1e-9),
+            3,
+        ),
+        # The memory-wall figure: the random-access gather plane (the
+        # whole arena story for serving; the fold/scatter streams are
+        # sequential and mode-invariant-or-cheap — see
+        # _arena_bytes_per_step)
+        "arena_gather_bytes_reduction": round(
+            1
+            - i8["arena_bytes_per_step"]["gather"]
+            / f32["arena_bytes_per_step"]["gather"],
+            3,
+        ),
+        "arena_total_bytes_reduction": round(
+            1
+            - i8["arena_bytes_per_step"]["total"]
+            / f32["arena_bytes_per_step"]["total"],
+            3,
+        ),
+        "auc_delta_int8_minus_fp32": round(
+            i8["auc_synthetic_criteo"] - f32["auc_synthetic_criteo"], 4
+        ),
     }
     return {
         "bench": "sparse_path",
@@ -1170,6 +1322,8 @@ def main():
             print(json.dumps(post(fn())))
     else:
         fn = {"full": bench_full, "deepfm": bench_deepfm,
+              "deepfm-int8": lambda: bench_deepfm(arena_dtype="int8"),
+              "deepfm_int8": lambda: bench_deepfm(arena_dtype="int8"),
               "mnist": bench_mnist, "bert": bench_bert,
               "serving": bench_serving,
               "serving-fleet": bench_serving_fleet,

@@ -64,6 +64,7 @@ def _train_local(args, job_type: str = "train") -> int:
         prediction_outputs_processor=getattr(
             args, "prediction_outputs_processor", ""
         ),
+        arena_dtype=getattr(args, "arena_dtype", ""),
     )
     args.job_type = job_type
     if job_type in ("evaluate", "predict") and not args.checkpoint_dir_for_init:
@@ -268,7 +269,8 @@ def build_serving_server(args):
             "--checkpoint_dir"
         )
     spec = get_model_spec(
-        args.model_zoo, args.model_def, model_params=args.model_params
+        args.model_zoo, args.model_def, model_params=args.model_params,
+        arena_dtype=getattr(args, "arena_dtype", ""),
     )
     buckets = tuple(
         int(b) for b in str(args.batch_buckets).split(",") if b.strip()

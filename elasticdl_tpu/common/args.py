@@ -267,6 +267,14 @@ def add_model_params(parser: argparse.ArgumentParser):
         "mnist.mnist_functional_api.custom_model",
     )
     parser.add_argument("--model_params", default="", help="free-form kwargs")
+    parser.add_argument(
+        "--arena_dtype", default="", choices=["", "float32", "int8"],
+        help="Embedding arena storage dtype: int8 stores rows as "
+        "quantized codes with per-row fp32 scales (docs/PERF.md "
+        "'Quantized arena'); empty defers to the model's default "
+        "(float32).  Forwarded into model_params for zoos whose "
+        "custom_model accepts arena_dtype.",
+    )
     parser.add_argument("--dataset_fn", default="feed")
     parser.add_argument("--loss", default="loss")
     parser.add_argument("--optimizer", default="optimizer")

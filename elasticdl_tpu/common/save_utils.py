@@ -75,6 +75,93 @@ def _swap_tree_keys(node, old: str, new: str):
     return node
 
 
+class ArenaDtypeMismatch(ValueError):
+    """A checkpoint's arena storage dtype differs from the configured
+    model's and no conversion was requested.  Raised INSTEAD of the jax
+    aval/structure crash the raw restore would produce, with the two
+    dtypes and the fix in the message."""
+
+
+def _state_arena_dtype(state) -> str:
+    """"int8" when a (possibly abstract) train state carries a
+    "quantized" collection, else "float32".  Structure-only."""
+    model_state = getattr(state, "model_state", None)
+    if isinstance(model_state, dict) and model_state.get("quantized"):
+        return "int8"
+    return "float32"
+
+
+def _arena_meta_of(state) -> Dict[str, Any]:
+    """Manifest metadata for the arena storage mode: the dtype plus, in
+    int8 mode, each quantized plane's path/rows/dim/scale shape — enough
+    to synthesize a restore template for dtype conversion without the
+    model that wrote the checkpoint."""
+    if _state_arena_dtype(state) == "float32":
+        return {"arena_dtype": "float32", "planes": {}}
+    from elasticdl_tpu.layers.arena import is_quantized_planes
+
+    planes: Dict[str, Any] = {}
+
+    def walk(node, path):
+        if is_quantized_planes(node):
+            planes["/".join(path)] = {
+                "rows": int(node["q8"].shape[0]),
+                "dim": int(node["q8"].shape[1]),
+                "scale_shape": [int(s) for s in node["scale"].shape],
+            }
+            return
+        for k in node:
+            walk(node[k], path + (k,))
+
+    walk(state.model_state["quantized"], ())
+    return {"arena_dtype": "int8", "planes": planes}
+
+
+def _planes_template_from_meta(meta: Dict[str, Any], params: Any):
+    """Rebuild the abstract "quantized" collection recorded in a
+    manifest: nested {path: {"q8", "scale"}} ShapeDtypeStructs.  Each
+    plane reuses the sharding of the params leaf at the same path (the
+    carrier has the q8 plane's exact shape), so a sharded restore lands
+    the planes where the table lives."""
+    import jax
+    import jax.numpy as jnp
+
+    quant: Dict[str, Any] = {}
+    for dotted, info in meta.get("planes", {}).items():
+        keys = dotted.split("/")
+        sharding = None
+        leaf = params.get("params", {})
+        try:
+            for k in keys:
+                leaf = leaf[k]
+            sharding = getattr(leaf, "sharding", None)
+        except (KeyError, TypeError):
+            leaf = None
+        node = quant
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        rows, dim = int(info["rows"]), int(info["dim"])
+        node[keys[-1]] = {
+            "q8": jax.ShapeDtypeStruct(
+                (rows, dim), jnp.int8, sharding=sharding
+            ),
+            "scale": jax.ShapeDtypeStruct(
+                tuple(info.get("scale_shape", (rows, 1))), jnp.float32,
+                sharding=sharding,
+            ),
+        }
+    return quant
+
+
+def _replace_state(state, params, model_state):
+    if hasattr(state, "replace"):
+        return state.replace(params=params, model_state=model_state)
+    out = dict(state)
+    out["params"] = params
+    out["model_state"] = model_state
+    return out
+
+
 def _tree_has_key(node, key: str) -> bool:
     if isinstance(node, dict):
         return key in node or any(
@@ -117,6 +204,10 @@ class CheckpointSaver:
                 enable_async_checkpointing=async_save,
             ),
         )
+        # arena storage metadata per saved step, cached at save() time
+        # (manifests are written later, after async finalize, with no
+        # access to the state)
+        self._arena_meta: Dict[int, Dict[str, Any]] = {}
 
     def save(self, state, force: bool = False) -> bool:
         import orbax.checkpoint as ocp
@@ -131,6 +222,10 @@ class CheckpointSaver:
             logger.warning("checkpoint save skipped (%s)", exc)
             return False
         step = int(state.step)
+        try:
+            self._arena_meta[step] = _arena_meta_of(state)
+        except Exception:
+            logger.exception("arena metadata capture failed")
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
@@ -181,6 +276,11 @@ class CheckpointSaver:
                 for rel in _step_files(step_dir)
             },
         }
+        # arena storage mode, when this process saved the step (absent
+        # for steps written before the quantized arena existed — those
+        # are all float32)
+        if step in self._arena_meta:
+            manifest["arena"] = self._arena_meta[step]
         path = self._manifest_path(step)
         tmp = path + ".tmp"
         # temp file + os.replace: readers only ever see a complete
@@ -229,16 +329,137 @@ class CheckpointSaver:
         if hasattr(self._mngr, "reload"):
             self._mngr.reload()
 
+    # ---- arena dtype compatibility -------------------------------------
+
+    def _manifest_arena_meta(self, step: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f).get("arena")
+        except (OSError, ValueError):
+            return None
+
+    def _checkpoint_arena_dtype(self, step: int) -> str:
+        """The arena storage mode a checkpointed step was written with:
+        from the manifest when recorded, else from the stored tree's
+        structure (a "quantized" subtree means int8), else float32 —
+        every pre-quantization checkpoint is fp32."""
+        meta = self._manifest_arena_meta(step)
+        if meta:
+            return meta.get("arena_dtype", "float32")
+        try:
+            stored = self._mngr.item_metadata(step)
+            stored = getattr(stored, "tree", stored)
+            if stored is not None and _tree_has_key(stored, "quantized"):
+                return "int8"
+        except Exception:
+            pass
+        return "float32"
+
+    def _arena_compat(self, step: int, abstract, arena_convert: bool):
+        """Reconcile the checkpoint's arena dtype with the template's.
+
+        Same dtype -> (abstract, None).  Different dtype without
+        `arena_convert` -> ArenaDtypeMismatch (a clear error instead of
+        the jax structure crash the raw restore would hit).  With
+        `arena_convert`, returns (source template matching the
+        CHECKPOINT's layout, post-restore converter into the CONFIGURED
+        layout) — both directions, via layers/arena.py's tree
+        converters; the carrier param shares the fp32 table's
+        name/shape, so adam moments survive either way."""
+        want = _state_arena_dtype(abstract)
+        have = self._checkpoint_arena_dtype(step)
+        if have == want:
+            return abstract, None
+        if not arena_convert:
+            raise ArenaDtypeMismatch(
+                f"checkpoint step {step} stores {have} arena rows but the "
+                f"configured model expects {want}: pass "
+                "arena_convert=True to migrate on restore, or set "
+                f"--arena_dtype {have} to match the checkpoint"
+            )
+        from elasticdl_tpu.layers.arena import (
+            dequantize_arena_tree,
+            quantize_arena_tree,
+        )
+
+        if have == "float32":  # fp32 checkpoint -> quantized config
+            quant_template = abstract.model_state["quantized"]
+            source = _replace_state(
+                abstract,
+                abstract.params,
+                {
+                    k: v for k, v in abstract.model_state.items()
+                    if k != "quantized"
+                },
+            )
+
+            def convert(restored):
+                inner, quant = quantize_arena_tree(
+                    restored.params["params"], quant_template
+                )
+                params = dict(restored.params)
+                params["params"] = inner
+                model_state = dict(restored.model_state)
+                model_state["quantized"] = quant
+                logger.info(
+                    "checkpoint step %d: quantized fp32 arena rows to "
+                    "int8 on restore", step,
+                )
+                return _replace_state(restored, params, model_state)
+
+            return source, convert
+
+        # quantized checkpoint -> fp32 config (serving export path)
+        meta = self._manifest_arena_meta(step)
+        if not meta or not meta.get("planes"):
+            raise ArenaDtypeMismatch(
+                f"checkpoint step {step} stores int8 arena rows but its "
+                "manifest records no plane shapes; cannot synthesize the "
+                "conversion template — restore with --arena_dtype int8 "
+                "instead"
+            )
+        quant_template = _planes_template_from_meta(meta, abstract.params)
+        source = _replace_state(
+            abstract,
+            abstract.params,
+            {**abstract.model_state, "quantized": quant_template},
+        )
+
+        def convert(restored):
+            inner = dequantize_arena_tree(
+                restored.params["params"],
+                restored.model_state["quantized"],
+            )
+            params = dict(restored.params)
+            params["params"] = inner
+            model_state = {
+                k: v for k, v in restored.model_state.items()
+                if k != "quantized"
+            }
+            logger.info(
+                "checkpoint step %d: dequantized int8 arena rows to "
+                "fp32 on restore", step,
+            )
+            return _replace_state(restored, params, model_state)
+
+        return source, convert
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
     def all_steps(self):
         return list(self._mngr.all_steps())
 
-    def restore_step(self, step: int, template: Any) -> Optional[Any]:
+    def restore_step(
+        self, step: int, template: Any, arena_convert: bool = False
+    ) -> Optional[Any]:
         """Restore a SPECIFIC checkpointed step into `template`'s
         shardings (eval-at-version: score the model the master asked
-        about, not whatever the leasing worker currently holds)."""
+        about, not whatever the leasing worker currently holds).
+
+        `arena_convert=True` migrates across arena storage dtypes
+        (fp32 checkpoint -> int8 config and back); without it a dtype
+        mismatch raises `ArenaDtypeMismatch`."""
         import jax
         import orbax.checkpoint as ocp
 
@@ -258,7 +479,10 @@ class CheckpointSaver:
             else x,
             template,
         )
+        abstract, convert = self._arena_compat(step, abstract, arena_convert)
         restored = self._restore_with_shims(step, abstract)
+        if convert is not None:
+            restored = convert(restored)
         logger.info("Restored checkpoint step %d (eval-at-version)", step)
         events.emit(events.CHECKPOINT_RESTORED, step=step)
         return restored
@@ -305,7 +529,9 @@ class CheckpointSaver:
             )
             return _swap_tree_keys(restored, "stack", "gpipe_stack")
 
-    def maybe_restore(self, template: Any) -> Optional[Any]:
+    def maybe_restore(
+        self, template: Any, arena_convert: bool = False
+    ) -> Optional[Any]:
         """Restore the newest INTACT checkpoint into the sharding/
         structure of `template` (an abstract or concrete train state).
 
@@ -314,7 +540,13 @@ class CheckpointSaver:
         write must cost one checkpoint interval of progress, never the
         job.  When every step fails to restore, the last restore error
         re-raises (callers must not silently train from scratch when
-        checkpoints exist but are all broken)."""
+        checkpoints exist but are all broken).
+
+        An arena storage dtype mismatch (checkpoint int8 vs configured
+        fp32 or vice versa) raises `ArenaDtypeMismatch` IMMEDIATELY —
+        older steps would mismatch the same way, and silently training
+        from scratch over a dtype flag is the worst outcome.  Pass
+        `arena_convert=True` to migrate instead."""
         import jax
 
         steps = sorted(self._mngr.all_steps(), reverse=True)
@@ -337,7 +569,14 @@ class CheckpointSaver:
                 )
                 continue
             try:
-                restored = self._restore_with_shims(step, abstract)
+                step_abstract, convert = self._arena_compat(
+                    step, abstract, arena_convert
+                )
+                restored = self._restore_with_shims(step, step_abstract)
+                if convert is not None:
+                    restored = convert(restored)
+            except ArenaDtypeMismatch:
+                raise
             except Exception as exc:
                 last_exc = exc
                 logger.warning(

@@ -217,10 +217,17 @@ class ServingEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         step: Optional[int] = None,
         precompile: bool = True,
+        arena_convert: bool = False,
     ) -> "ServingEngine":
         """Serve straight from a training checkpoint directory
         (manifest-verified via CheckpointSaver; the optimizer state is
-        restored as part of the TrainState and discarded)."""
+        restored as part of the TrainState and discarded).
+
+        `arena_convert=True` lets a checkpoint whose arena storage
+        dtype differs from the configured model's migrate on restore —
+        e.g. serve an int8-trained checkpoint through an fp32 config
+        (the export direction) or vice versa; without it a mismatch
+        raises `ArenaDtypeMismatch` (save_utils)."""
         from elasticdl_tpu.common.save_utils import CheckpointSaver
 
         template = build_state_template(spec, sample_features)
@@ -233,7 +240,9 @@ class ServingEngine:
                     f"no checkpoints found in {checkpoint_dir}"
                 )
             restored = run_device_serialized(
-                saver.restore_step, step, template
+                lambda: saver.restore_step(
+                    step, template, arena_convert=arena_convert
+                )
             )
             if restored is None:
                 raise ValueError(

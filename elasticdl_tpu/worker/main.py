@@ -171,6 +171,7 @@ def _main(argv=None):
         prediction_outputs_processor=getattr(
             args, "prediction_outputs_processor", ""
         ),
+        arena_dtype=getattr(args, "arena_dtype", ""),
     )
     if spec.custom_data_reader is not None:
         reader = spec.custom_data_reader(data_origin=args.training_data)

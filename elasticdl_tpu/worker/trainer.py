@@ -30,6 +30,7 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.layers.arena import fold_quantized_updates
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 logger = get_logger(__name__)
@@ -220,11 +221,23 @@ class Trainer:
         by sharded embedding tables / tensor parallelism)."""
         if self._param_sharding_fn is None:
             return jax.tree.map(lambda _: self._repl, state)
-        model_state_sh = jax.tree.map(lambda _: self._repl, state.model_state)
 
         def spec_for(path, leaf):
             spec = self._param_sharding_fn(path, leaf)
             return NamedSharding(self.mesh, spec if spec is not None else P())
+
+        # model_state replicates EXCEPT the "quantized" collection: its
+        # int8/scale planes mirror arena tables and must row-shard with
+        # them (the path contains "embedding", so the same sharding fn
+        # applies).
+        model_state_sh = {
+            key: (
+                jax.tree_util.tree_map_with_path(spec_for, sub)
+                if key == "quantized"
+                else jax.tree.map(lambda _: self._repl, sub)
+            )
+            for key, sub in state.model_state.items()
+        }
 
         params_sh = jax.tree_util.tree_map_with_path(spec_for, state.params)
         # Optax states embed per-param moment trees with the SAME pytree
@@ -299,6 +312,13 @@ class Trainer:
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
+            # Quantized arenas: fold the carrier's delta back into the
+            # int8 planes with stochastic rounding and zero the carrier.
+            # Trace-time no-op when no "quantized" collection exists, so
+            # the fp32 path stays bit-identical (layers/arena.py).
+            params, new_model_state = fold_quantized_updates(
+                params, new_model_state, state.step
+            )
             return (
                 TrainState(
                     step=state.step + 1,
@@ -607,6 +627,16 @@ class Trainer:
                 anchor = sum(
                     leaf.ravel()[0].astype(jnp.float32)
                     for leaf in jax.tree.leaves(out.params)
+                )
+                # quantized arenas: the int8 planes live in model_state
+                # and the fold chain feeds ONLY them (the carrier is
+                # zeroed) — without anchoring them XLA would DCE the
+                # whole requantize and overstate int8 speed
+                anchor = anchor + sum(
+                    leaf.ravel()[0].astype(jnp.float32)
+                    for leaf in jax.tree.leaves(
+                        out.model_state.get("quantized", {})
+                    )
                 )
                 return out.step, anchor
 

@@ -61,6 +61,8 @@ class WideAndDeep(nn.Module):
     vocab_capacity: int = 4096
     embed_dim: int = 8
     mlp_dims: tuple = (64, 32)
+    # "int8": quantized arena storage (docs/PERF.md "Quantized arena")
+    arena_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, features):
@@ -74,7 +76,7 @@ class WideAndDeep(nn.Module):
         # (per-feature row ranges inside one arena parameter)
         deep_vecs = EmbeddingArena(
             deep_arena_features(self.vocab_capacity), self.embed_dim,
-            name="deep_embedding",
+            name="deep_embedding", arena_dtype=self.arena_dtype,
         )({name: cat[:, j] for j, name in enumerate(CATEGORICAL_COLS)})
         emb = jnp.stack(
             [deep_vecs[name] for name in CATEGORICAL_COLS], axis=1
@@ -90,7 +92,7 @@ class WideAndDeep(nn.Module):
         wide_ids = jnp.concatenate([cat, cross], axis=1)    # (B, 10)
         wide_vecs = EmbeddingArena(
             wide_arena_features(self.vocab_capacity), 1,
-            name="wide_linear",
+            name="wide_linear", arena_dtype=self.arena_dtype,
         )({name: wide_ids[:, j] for j, name in enumerate(_WIDE_COLS)})
         wide = sum(wide_vecs[name][..., 0] for name in _WIDE_COLS)
         wide = wide + nn.Dense(1, name="wide_numeric")(numeric)[..., 0]
@@ -98,8 +100,14 @@ class WideAndDeep(nn.Module):
         return wide + deep  # logits
 
 
-def custom_model(vocab_capacity: int = 4096, embed_dim: int = 8):
-    return WideAndDeep(vocab_capacity=vocab_capacity, embed_dim=embed_dim)
+def custom_model(
+    vocab_capacity: int = 4096, embed_dim: int = 8,
+    arena_dtype: str = "float32",
+):
+    return WideAndDeep(
+        vocab_capacity=vocab_capacity, embed_dim=embed_dim,
+        arena_dtype=arena_dtype,
+    )
 
 
 def loss(labels, predictions):

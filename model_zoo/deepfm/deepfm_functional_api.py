@@ -2,10 +2,12 @@
 (BASELINE.md #4).  Zoo-contract port of the reference's
 model_zoo/deepfm* (SURVEY.md C20) re-designed TPU-first:
 
-- all 26 sparse fields share ONE DistributedEmbedding table (row-sharded
-  over the mesh `model` axis) addressed by field-offset ids — a single
-  large gather per step instead of 26 small ones keeps the lookup and its
-  scatter-add gradient efficient on TPU;
+- all 26 sparse fields share ONE embedding table (a single-feature
+  `EmbeddingArena`, row-sharded over the mesh `model` axis) addressed by
+  field-offset ids — a single large gather per step instead of 26 small
+  ones keeps the lookup and its scatter-add gradient efficient on TPU;
+  `arena_dtype="int8"` switches the table to quantized storage
+  (docs/PERF.md "Quantized arena");
 - FM second-order term uses the square-of-sum trick (two reductions, no
   O(fields^2) pairwise products);
 - the deep tower is a plain MLP on the MXU.
@@ -21,10 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from elasticdl_tpu.layers.embedding import (
-    DistributedEmbedding,
-    embedding_param_sharding,
-)
+from elasticdl_tpu.layers.arena import EmbeddingArena
+from elasticdl_tpu.layers.embedding import embedding_param_sharding
 from model_zoo.common.metrics import auc, binary_accuracy
 
 NUM_DENSE = 13
@@ -107,6 +107,18 @@ def normalize_dense(dense: jnp.ndarray) -> jnp.ndarray:
     return jnp.log1p(jnp.abs(dense)) * jnp.sign(dense)
 
 
+def arena_field_lookup(arena, field_ids, prehashed):
+    """Call a single-feature `EmbeddingArena` with DeepFM's (B, 26)
+    shared-hash-space field rows: prehashed rows go straight through
+    (arena rows == table rows at offset 0); raw ids route through the
+    dict path under the one feature name.  Numerically identical to the
+    `DistributedEmbedding` call it replaced (same param path/init, same
+    hash, offset 0) — `tests/test_sparse_path.py` pins that."""
+    if prehashed:
+        return arena(field_ids, prehashed=True)
+    return arena({"sparse": field_ids})["sparse"]
+
+
 class DeepFM(nn.Module):
     vocab_capacity: int = 1 << 18  # shared table rows (hash space)
     embed_dim: int = 16
@@ -116,6 +128,8 @@ class DeepFM(nn.Module):
     # param_dtype=f32 by default) and the FM reductions stay f32 for
     # numerical safety.
     compute_dtype: jnp.dtype = jnp.float32
+    # "int8": quantized arena storage (docs/PERF.md "Quantized arena")
+    arena_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, features):
@@ -126,14 +140,17 @@ class DeepFM(nn.Module):
         )
 
         # second-order / deep embeddings: (B, 26, k)
-        emb = DistributedEmbedding(
-            self.vocab_capacity, self.embed_dim, hash_input=True,
-            name="fm_embedding",
-        )(field_ids, prehashed=prehashed)
+        emb = arena_field_lookup(EmbeddingArena(
+            (("sparse", self.vocab_capacity),), self.embed_dim,
+            hash_input=True, name="fm_embedding",
+            arena_dtype=self.arena_dtype,
+        ), field_ids, prehashed)
         # first-order weights: (B, 26, 1)
-        first = DistributedEmbedding(
-            self.vocab_capacity, 1, hash_input=True, name="fm_linear",
-        )(field_ids, prehashed=prehashed)
+        first = arena_field_lookup(EmbeddingArena(
+            (("sparse", self.vocab_capacity),), 1,
+            hash_input=True, name="fm_linear",
+            arena_dtype=self.arena_dtype,
+        ), field_ids, prehashed)
 
         # FM second order: 0.5 * sum_k [ (sum_f v)^2 - sum_f v^2 ]
         sum_f = jnp.sum(emb, axis=1)
@@ -160,7 +177,8 @@ class DeepFM(nn.Module):
 
 
 def custom_model(
-    vocab_capacity: int = 1 << 18, embed_dim: int = 16, bf16: bool = False
+    vocab_capacity: int = 1 << 18, embed_dim: int = 16, bf16: bool = False,
+    arena_dtype: str = "float32",
 ):
     global DEDUP_VOCAB_CAPACITY
     # the dedup feed hashes on the HOST, so it must use the capacity the
@@ -170,6 +188,7 @@ def custom_model(
         vocab_capacity=vocab_capacity,
         embed_dim=embed_dim,
         compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        arena_dtype=arena_dtype,
     )
 
 

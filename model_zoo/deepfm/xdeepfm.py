@@ -22,15 +22,14 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
-from elasticdl_tpu.layers.embedding import (
-    DistributedEmbedding,
-    embedding_param_sharding,
-)
+from elasticdl_tpu.layers.arena import EmbeddingArena
+from elasticdl_tpu.layers.embedding import embedding_param_sharding
 from model_zoo.common.metrics import auc, binary_accuracy
 from model_zoo.deepfm.deepfm_functional_api import (
     NUM_DENSE,
     NUM_SPARSE,
     RECORD_BYTES,
+    arena_field_lookup,
     feed,
     feed_bulk,
     feed_bulk_compact,
@@ -83,6 +82,7 @@ class XDeepFM(nn.Module):
     cin_widths: tuple = (64, 64)
     mlp_dims: tuple = (256, 128)
     compute_dtype: jnp.dtype = jnp.float32
+    arena_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, features):
@@ -90,13 +90,16 @@ class XDeepFM(nn.Module):
             features, self.vocab_capacity
         )
 
-        emb = DistributedEmbedding(
-            self.vocab_capacity, self.embed_dim, hash_input=True,
-            name="fm_embedding",
-        )(field_ids, prehashed=prehashed)                   # (B, 26, k)
-        first = DistributedEmbedding(
-            self.vocab_capacity, 1, hash_input=True, name="fm_linear",
-        )(field_ids, prehashed=prehashed)
+        emb = arena_field_lookup(EmbeddingArena(
+            (("sparse", self.vocab_capacity),), self.embed_dim,
+            hash_input=True, name="fm_embedding",
+            arena_dtype=self.arena_dtype,
+        ), field_ids, prehashed)                            # (B, 26, k)
+        first = arena_field_lookup(EmbeddingArena(
+            (("sparse", self.vocab_capacity),), 1,
+            hash_input=True, name="fm_linear",
+            arena_dtype=self.arena_dtype,
+        ), field_ids, prehashed)
 
         cin_out = CIN(self.cin_widths, name="cin")(emb)
         cin_logit = nn.Dense(1, name="cin_out")(cin_out)[..., 0]
@@ -126,6 +129,7 @@ def custom_model(
     embed_dim: int = 16,
     bf16: bool = False,
     cin_widths: tuple = (64, 64),
+    arena_dtype: str = "float32",
 ):
     from model_zoo.deepfm import deepfm_functional_api as _shared
 
@@ -136,6 +140,7 @@ def custom_model(
         embed_dim=embed_dim,
         cin_widths=tuple(cin_widths),
         compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        arena_dtype=arena_dtype,
     )
 
 

@@ -33,5 +33,6 @@ from scripts.graftlint import (  # noqa: F401,E402
     rules_drift,
     rules_locks,
     rules_metrics,
+    rules_quant,
     rules_retries,
 )

@@ -1,0 +1,143 @@
+"""GL-QUANT: quantized-plane hygiene — raw int8 embedding codes must
+not be consumed by arithmetic outside `elasticdl_tpu/layers/arena.py`.
+
+The quantized arena (ISSUE 9) stores embedding rows as int8 codes plus a
+per-row fp32 scale, under the `q8` / `scale` keys of the "quantized"
+flax collection.  The codes are MEANINGLESS as numbers without their
+scale: `q8 + delta`, `q8.astype(f32) @ w`, or `q8 > 0` silently treats a
+[-127, 127] code as a real value and produces garbage that no dtype
+check will catch (int8 promotes happily).  Every value-consuming use
+must go through `dequantize_rows` / `dequantize_arena_tree`, and every
+write-back through `quantize_rows` / `stochastic_round` — all of which
+live in `layers/arena.py`, the one module allowed to do plane math.
+
+Findings: a BinOp, arithmetic UnaryOp (``-``/``+``/``~``), AugAssign,
+Compare, or `.astype(...)` call whose operands mention a `q8`-named
+identifier (names, attribute components, or string subscript keys such
+as ``planes["q8"]``), in any scanned file other than the arena module.
+Metadata access (`.shape`, `.dtype`, `.ndim`, `.size`, `.nbytes`) is
+not value consumption and never fires — checkpoint/manifest code reads
+plane shapes legitimately.
+
+Escapes: a `# graftlint: disable=GL-QUANT` line suppression (say why
+the raw-code arithmetic is sound), or the rule's (path, token)
+allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import FrozenSet, Tuple
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-QUANT"
+
+# The one module allowed to do arithmetic on raw code planes.
+ARENA_MODULE = "elasticdl_tpu/layers/arena.py"
+
+# Identifier tokens that name the raw int8 code plane.
+Q8_TOKEN_RE = re.compile(r"(^|_)q8($|_)")
+
+# Attribute reads that inspect a plane without consuming its values.
+_META_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "nbytes"})
+
+# Boolean `not` is excluded: flag only numeric unary operators.
+_ARITH_UNARY = (ast.USub, ast.UAdd, ast.Invert)
+
+DEFAULT_ALLOWLIST: FrozenSet[Tuple[str, str]] = frozenset()
+
+
+def _q8_token(node: ast.AST):
+    """The first q8-vocabulary identifier consumed BY VALUE inside
+    `node`, or None.  Subtrees behind a metadata attribute (`.shape`
+    etc.) are pruned — shape/dtype inspection is not plane math."""
+    if isinstance(node, ast.Attribute):
+        if node.attr in _META_ATTRS:
+            return None
+        if Q8_TOKEN_RE.search(node.attr):
+            return node.attr
+    elif isinstance(node, ast.Name):
+        if Q8_TOKEN_RE.search(node.id):
+            return node.id
+    elif isinstance(node, ast.Subscript):
+        sl = node.slice
+        if (isinstance(sl, ast.Constant) and isinstance(sl.value, str)
+                and Q8_TOKEN_RE.search(sl.value)):
+            return sl.value
+    for child in ast.iter_child_nodes(node):
+        token = _q8_token(child)
+        if token is not None:
+            return token
+    return None
+
+
+def _is_astype(func: ast.AST) -> bool:
+    return isinstance(func, ast.Attribute) and func.attr == "astype"
+
+
+def find_raw_plane_arithmetic(tree: ast.AST):
+    """Yield (lineno, message, token) for arithmetic over q8-named
+    values.  One finding per line: nested operand trees (a BinOp inside
+    a Compare) would otherwise double-report the same expression."""
+    seen_lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp):
+            operands, what = (node.left, node.right), "arithmetic"
+        elif isinstance(node, ast.UnaryOp) \
+                and isinstance(node.op, _ARITH_UNARY):
+            operands, what = (node.operand,), "arithmetic"
+        elif isinstance(node, ast.AugAssign):
+            operands, what = (node.target, node.value), "arithmetic"
+        elif isinstance(node, ast.Compare):
+            operands, what = (node.left, *node.comparators), "comparison"
+        elif isinstance(node, ast.Call) and _is_astype(node.func):
+            operands, what = (node.func.value,), "astype"
+        else:
+            continue
+        if node.lineno in seen_lines:
+            continue
+        for operand in operands:
+            token = _q8_token(operand)
+            if token is not None:
+                seen_lines.add(node.lineno)
+                yield (
+                    node.lineno,
+                    f"{what} over raw int8 plane {token!r}: the codes "
+                    "are meaningless without their per-row scale — use "
+                    "dequantize_rows()/dequantize_arena_tree() from "
+                    "layers/arena.py (the one module allowed to do "
+                    "plane math)",
+                    token,
+                )
+                break
+
+
+class QuantRule(Rule):
+    id = RULE_ID
+    title = "no raw int8 plane arithmetic outside layers/arena.py"
+    rationale = (
+        "int8 embedding codes are only meaningful with their per-row "
+        "scale; arithmetic on the raw plane outside the arena module "
+        "produces silently-wrong values no dtype check catches"
+    )
+
+    def __init__(
+        self,
+        allowlist: FrozenSet[Tuple[str, str]] = DEFAULT_ALLOWLIST,
+    ):
+        # (repo-relative path, q8 identifier) pairs proven benign
+        self.allowlist = frozenset(allowlist)
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return pf.rel != ARENA_MODULE
+
+    def check(self, pf: ParsedFile):
+        for lineno, message, token in find_raw_plane_arithmetic(pf.tree):
+            if (pf.rel, token) in self.allowlist:
+                continue
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(QuantRule())

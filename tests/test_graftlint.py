@@ -30,12 +30,13 @@ from scripts.graftlint import (  # noqa: E402
     rules_drift,
     rules_locks,
     rules_metrics,
+    rules_quant,
     rules_retries,
 )
 
 ALL_IDS = {
     "GL-BOUNDARY", "GL-CLOCK", "GL-DONATE", "GL-DRIFT",
-    "GL-LOCK", "GL-METRIC", "GL-RETRY",
+    "GL-LOCK", "GL-METRIC", "GL-QUANT", "GL-RETRY",
 }
 
 
@@ -46,7 +47,7 @@ def _ids(findings):
 # ---- framework ----------------------------------------------------------
 
 
-def test_registry_has_all_seven_rules():
+def test_registry_has_all_eight_rules():
     assert set(core.all_rules()) == ALL_IDS
 
 
@@ -439,6 +440,58 @@ def test_drift_skipped_on_partial_scan():
         REPO, [os.path.join("elasticdl_tpu", "worker", "worker.py")]
     )
     assert not list(rules_drift.DriftRule().check_project(project))
+
+
+# ---- GL-QUANT -----------------------------------------------------------
+
+
+def test_quant_positive_binop_on_plane_key():
+    src = "deq = planes['q8'] * 0.01\n"
+    found = check_source(src, "elasticdl_tpu/serving/x.py",
+                         [rules_quant.QuantRule()])
+    assert _ids(found) == ["GL-QUANT"]
+    assert "dequantize_rows" in found[0].message
+
+
+def test_quant_positive_astype_and_compare():
+    src = (
+        "a = q8.astype(jnp.float32)\n"
+        "hot = q8_plane > 0\n"
+    )
+    found = check_source(src, "elasticdl_tpu/worker/x.py",
+                         [rules_quant.QuantRule()])
+    assert _ids(found) == ["GL-QUANT", "GL-QUANT"]
+
+
+def test_quant_arena_module_is_exempt():
+    # the one module allowed to do plane math
+    src = "deq = planes['q8'] * scale\n"
+    assert not check_source(src, "elasticdl_tpu/layers/arena.py",
+                            [rules_quant.QuantRule()])
+
+
+def test_quant_metadata_access_is_not_consumption():
+    # checkpoint code compares plane shapes/dtypes legitimately
+    src = (
+        "ok = planes['q8'].shape[0] == rows\n"
+        "bad_dtype = planes['q8'].dtype != jnp.int8\n"
+    )
+    assert not check_source(src, "elasticdl_tpu/common/x.py",
+                            [rules_quant.QuantRule()])
+
+
+def test_quant_suppressed():
+    src = "deq = q8 * 0.01  # graftlint: disable=GL-QUANT\n"
+    assert not check_source(src, "elasticdl_tpu/worker/x.py",
+                            [rules_quant.QuantRule()])
+
+
+def test_quant_allowlisted_token():
+    rule = rules_quant.QuantRule(
+        allowlist=frozenset({("elasticdl_tpu/worker/x.py", "q8")})
+    )
+    src = "deq = q8 * 0.01\n"
+    assert not check_source(src, "elasticdl_tpu/worker/x.py", [rule])
 
 
 # ---- acceptance demos (ISSUE exit-1 criteria) ---------------------------
