@@ -885,8 +885,9 @@ def bench_serving_fleet(
     the ServingFleetManager absorbs one mid-run replica kill and
     sequences one rolling hot-reload (docs/SERVING.md "Fleet").  Reports
     client-observed p50/p99, the failed-request count (the failover
-    guarantee says it must be 0), and the max observed cross-replica
-    model_step skew vs the SLO."""
+    guarantee says it must be 0), the max observed cross-replica
+    model_step skew vs the SLO, train-to-serve staleness p50/p99, and
+    the max staleness burn rate the SLO evaluator saw during the roll."""
     import tempfile
     import threading
     import time
@@ -895,10 +896,13 @@ def bench_serving_fleet(
     import jax.numpy as jnp
 
     from elasticdl_tpu.common.constants import PodStatus
+    from elasticdl_tpu.common.history import MetricHistory
     from elasticdl_tpu.common.k8s_client import FakeK8sClient
     from elasticdl_tpu.common.model_handler import get_model_spec
     from elasticdl_tpu.common.resilience import RetryPolicy
     from elasticdl_tpu.common.save_utils import CheckpointSaver
+    from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
+    from elasticdl_tpu.master.freshness import FreshnessTracker
     from elasticdl_tpu.master.serving_fleet import (
         ServingFleetConfig,
         ServingFleetManager,
@@ -974,10 +978,18 @@ def bench_serving_fleet(
             return fleet[rid]["client"]
 
         k8s = FakeK8sClient()
-        router = FleetRouter(retry_policy=RetryPolicy(
-            initial_backoff_s=0.001, max_backoff_s=0.01,
-            max_elapsed_s=30.0, max_attempts=8,
-        ))
+        freshness = FreshnessTracker(
+            produced_time_fn=lambda step: (
+                saver.produced_meta(step) or {}
+            ).get("produced_unix_s"),
+        )
+        router = FleetRouter(
+            retry_policy=RetryPolicy(
+                initial_backoff_s=0.001, max_backoff_s=0.01,
+                max_elapsed_s=30.0, max_attempts=8,
+            ),
+            freshness=freshness,
+        )
         manager = ServingFleetManager(
             k8s,
             ServingFleetConfig(
@@ -989,9 +1001,26 @@ def bench_serving_fleet(
             reload_fn=lambda rid: fleet[rid]["reloader"].check_once(),
             pending_step_fn=lambda: latest[0],
             router=router,
+            freshness=freshness,
         )
         manager.place()
         manager.tick()  # prime: every replica probed healthy
+
+        # staleness SLO watcher riding the same freshness evidence the
+        # master would evaluate; ticked after every fleet tick
+        history = MetricHistory(
+            registries=[freshness.metrics_registry,
+                        manager.metrics_registry],
+        )
+        evaluator = SloEvaluator(history, specs=[shipped_specs()[0]])
+        max_burn = [0.0]
+
+        def observe_slo():
+            history.tick()
+            evaluator.tick()
+            max_burn[0] = max(max_burn[0], evaluator.max_burn())
+
+        observe_slo()
 
         sizes = (1, 2, 5, 8)  # mixed request sizes, exercising padding
         latencies, failed = [], []
@@ -1034,15 +1063,19 @@ def bench_serving_fleet(
                  PodStatus.FAILED, exit_code=1)
         time.sleep(0.05)  # a probe-interval of traffic hits the dead pod
         manager.tick()  # sees the FAILED pod -> relaunch
+        observe_slo()
         time.sleep(0.05)
         save_step(2, 1.5)
         latest[0] = 2
         for _ in range(replicas + 1):
             manager.tick()  # one sequenced hot-swap per tick
+            observe_slo()
             time.sleep(0.03)
         for t in threads:
             t.join()
+        observe_slo()
         elapsed = time.perf_counter() - t0
+        staleness = freshness.quantiles()
 
         snap = manager.snapshot()
         stats = router.stats()
@@ -1070,6 +1103,11 @@ def bench_serving_fleet(
                 router.max_observed_step_skew,
             ),
             "step_skew_slo": snap["step_skew_slo"],
+            "staleness_p50_steps": staleness["staleness_p50_steps"],
+            "staleness_p99_steps": staleness["staleness_p99_steps"],
+            "staleness_p50_s": staleness["staleness_p50_s"],
+            "staleness_p99_s": staleness["staleness_p99_s"],
+            "max_burn_rate": round(max_burn[0], 3),
         },
     }
 

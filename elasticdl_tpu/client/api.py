@@ -84,6 +84,19 @@ def _train_local(args, job_type: str = "train") -> int:
         events.configure_from_env(role="local")
     master = Master(args)
     master.start_telemetry(getattr(args, "telemetry_port", 0))
+    # The Local path never calls Master.start() (nothing to place on a
+    # cluster), so the metric-history/SLO loops must start here for
+    # --history_interval/--slo_interval to cover dev runs too.
+    if master.metric_history is not None and master.metric_history.start():
+        logger.info(
+            "Metric history sampling every %.1fs",
+            master.metric_history.interval_s,
+        )
+    if master.slo_evaluator is not None and master.slo_evaluator.start():
+        logger.info(
+            "SLO evaluator ticking every %.1fs",
+            master.slo_evaluator.interval_s,
+        )
     client = InProcessMasterClient(master.servicer)
     data_origin = {
         "train": args.training_data,
@@ -184,6 +197,10 @@ def _train_local(args, job_type: str = "train") -> int:
     ok = master.wait()
     for thread in threads:
         thread.join(timeout=60)
+    if master.slo_evaluator is not None:
+        master.slo_evaluator.stop()
+    if master.metric_history is not None:
+        master.metric_history.stop()
     if owner.checkpoint_saver is not None:
         # flush any in-flight async checkpoint writes
         owner.checkpoint_saver.wait_until_finished()

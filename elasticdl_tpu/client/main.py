@@ -74,6 +74,21 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     top_parser.set_defaults(func="top")
 
+    slo_parser = subparsers.add_parser(
+        "slo", help="SLO report (state, burn rates, window evidence) "
+        "from a master's /varz endpoint"
+    )
+    slo_parser.add_argument(
+        "master_varz",
+        help="master telemetry address: host:port or http URL "
+        "(--telemetry_port of the master)",
+    )
+    slo_parser.add_argument(
+        "--json", action="store_true",
+        help="dump the raw SLO snapshot as JSON instead of the table",
+    )
+    slo_parser.set_defaults(func="slo")
+
     trace_parser = subparsers.add_parser(
         "trace",
         help="convert an --event_log JSONL to Chrome trace JSON "
@@ -128,6 +143,10 @@ def main(argv=None) -> int:
         from elasticdl_tpu.client.top import top
 
         return top(args)
+    if args.func == "slo":
+        from elasticdl_tpu.client.slo import slo
+
+        return slo(args)
     if args.func == "trace":
         from elasticdl_tpu.client.trace import trace
 

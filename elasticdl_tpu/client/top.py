@@ -53,9 +53,11 @@ def _fmt(value, width: int) -> str:
     return text.rjust(width)
 
 
-def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
+def render(varz: dict, serving_varz: Optional[dict] = None,
+           clock=time.time) -> str:
     """One refresh frame: cluster summary + per-worker table (+ serving
-    row when a serving /varz was scraped)."""
+    row when a serving /varz was scraped).  `clock` is injectable so
+    tests render deterministic "ago" columns."""
     lines = []
     snapshot = varz.get("snapshot", {})
     tasks = snapshot.get("tasks", {})
@@ -118,6 +120,31 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
             f"skew={fleet.get('model_step_skew', 0)}"
             f"/slo={slo if slo else '-'}"
         )
+    slo = snapshot.get("slo")
+    if slo:
+        states = slo.get("states", {})
+        burns = {
+            row.get("slo"): row.get("fast_burn", 0.0)
+            for row in slo.get("slos", [])
+        }
+        lines.append(
+            "slo: " + " ".join(
+                f"{name}={states[name]}"
+                + (f"({burns[name]:.1f}x)" if burns.get(name) else "")
+                for name in sorted(states)
+            )
+        )
+    freshness = snapshot.get("freshness")
+    if freshness:
+        lines.append(
+            "freshness: latest_step={step} staleness "
+            "p50={p50:.2f}s p99={p99:.2f}s obs={obs}".format(
+                step=freshness.get("latest_step", 0),
+                p50=freshness.get("staleness_p50_s", 0.0),
+                p99=freshness.get("staleness_p99_s", 0.0),
+                obs=freshness.get("observations", 0),
+            )
+        )
     recovery = snapshot.get("recovery")
     if recovery:
         durations = recovery.get("recovery_durations_s", [])
@@ -148,7 +175,7 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
             + "top_phase".rjust(16)
             + "flag".rjust(14)
         )
-        now = time.time()
+        now = clock()
         for wid in sorted(workers, key=lambda w: int(w)):
             entry = workers[wid]
             ago = now - entry.get("last_report_unix_s", now)
@@ -209,11 +236,15 @@ def render(varz: dict, serving_varz: Optional[dict] = None) -> str:
     return "\n".join(lines)
 
 
-def top(args) -> int:
-    """Render the cluster table; --watch refreshes until interrupted."""
+def top(args, clock=time.time, sleep=time.sleep,
+        max_frames: Optional[int] = None) -> int:
+    """Render the cluster table; --watch redraws in place until
+    interrupted.  `clock`/`sleep` are injectable and `max_frames`
+    bounds the watch loop so tests run one deterministic iteration."""
     interval = getattr(args, "interval_s", 2.0)
     watch = getattr(args, "watch", False)
     serving_addr = getattr(args, "serving_addr", "")
+    frames = 0
     while True:
         try:
             varz = fetch_varz(args.master_varz)
@@ -226,11 +257,16 @@ def top(args) -> int:
                 serving_varz = fetch_varz(serving_addr)
             except Exception:
                 pass  # serving replica down: keep showing the master
-        frame = render(varz, serving_varz)
-        if watch:
-            # ANSI clear + home: cheap full-screen refresh, no curses
-            print("\033[2J\033[H" + frame, flush=True)
-            time.sleep(interval)
-        else:
+        frame = render(varz, serving_varz, clock=clock)
+        if not watch:
             print(frame)
             return 0
+        # In-place redraw: wipe the screen once, then home the cursor,
+        # repaint, and clear whatever a previously-taller frame left
+        # below — no scrollback spam between refreshes.
+        prefix = "\033[2J\033[H" if frames == 0 else "\033[H"
+        print(prefix + frame + "\033[J", flush=True)
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            return 0
+        sleep(interval)

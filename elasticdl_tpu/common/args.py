@@ -254,6 +254,33 @@ def add_common_params(parser: argparse.ArgumentParser):
         help="gRPC port each serving replica listens on (the fleet "
         "manager probes {replica-service}:{this port}).",
     )
+    # ---- metric history + SLOs (common/history.py, common/slo.py,
+    #      docs/OBSERVABILITY.md "Metric history & SLOs") ----
+    parser.add_argument(
+        "--history_interval", type=float, default=0.0,
+        help="Seconds between metric-history samples (ring-buffer "
+        "recorder over every /metrics registry; the evidence the SLO "
+        "evaluator and `elasticdl slo` read).  0 disables the sampling "
+        "thread; tests tick by hand.",
+    )
+    parser.add_argument(
+        "--history_capacity", type=pos_int, default=512,
+        help="Samples retained per metric series in the history ring "
+        "buffer (oldest evicted first).  Must cover the slowest SLO "
+        "window: capacity * --history_interval >= slow_window_s.",
+    )
+    parser.add_argument(
+        "--slo_interval", type=float, default=0.0,
+        help="Seconds between SLO evaluator ticks (burn-rate math over "
+        "the metric history; emits slo_breach/slo_recovered span "
+        "events).  0 disables the thread; tests tick by hand.",
+    )
+    parser.add_argument(
+        "--slo_staleness_p99_s", type=float, default=60.0,
+        help="Objective of the staleness_p99 SLO: 99%% of predict "
+        "responses must be served from a checkpoint no older than this "
+        "many seconds behind the latest produced one.",
+    )
 
 
 def add_model_params(parser: argparse.ArgumentParser):

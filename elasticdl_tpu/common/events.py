@@ -46,6 +46,8 @@ POLICY_DECISION = "policy_decision"    # master policy engine acted
 SERVING_REPLICA_RELAUNCHED = "serving_replica_relaunched"  # fleet replaced
 FLEET_RELOAD_STEP = "fleet_reload_step"        # one replica hot-swapped
 FLEET_RELOAD_REFUSED = "fleet_reload_refused"  # skew SLO blocked a reload
+SLO_BREACH = "slo_breach"          # burn rate crossed an alert threshold
+SLO_RECOVERED = "slo_recovered"    # burn rate back inside the budget
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -56,7 +58,7 @@ VOCABULARY = frozenset({
     CHECKPOINT_SAVED, CHECKPOINT_RESTORED, SERVING_RELOADED,
     RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
     POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
-    FLEET_RELOAD_REFUSED,
+    FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every

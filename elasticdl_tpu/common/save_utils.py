@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Any, Dict, Optional
 
 from elasticdl_tpu.common import events, faults
@@ -181,14 +182,33 @@ def _tree_has_key(node, key: str) -> bool:
     return False
 
 
+def read_produced_meta(checkpoint_dir: str,
+                       step: int) -> Optional[Dict[str, Any]]:
+    """Read a manifest's producer freshness stamp without a saver (the
+    master's FreshnessTracker watches a directory a trainer writes)."""
+    path = os.path.join(
+        os.path.abspath(checkpoint_dir), ".manifests", f"{int(step)}.json"
+    )
+    try:
+        with open(path) as f:
+            return json.load(f).get("produced")
+    except (OSError, ValueError):
+        return None
+
+
 class CheckpointSaver:
     def __init__(
         self,
         checkpoint_dir: str,
         keep_max: int = 3,
         async_save: bool = True,
+        clock=time.time,
     ):
         import orbax.checkpoint as ocp
+
+        # injectable for deterministic freshness stamps under fake
+        # clocks (docs/OBSERVABILITY.md "Metric history & SLOs")
+        self._clock = clock
 
         self._dir = os.path.abspath(checkpoint_dir)
         os.makedirs(self._dir, exist_ok=True)
@@ -208,6 +228,9 @@ class CheckpointSaver:
         # (manifests are written later, after async finalize, with no
         # access to the state)
         self._arena_meta: Dict[int, Dict[str, Any]] = {}
+        # producer freshness stamp per saved step, same cached-at-save
+        # pattern — the train-to-serve staleness trace starts here
+        self._produced_meta: Dict[int, Dict[str, Any]] = {}
 
     def save(self, state, force: bool = False) -> bool:
         import orbax.checkpoint as ocp
@@ -226,6 +249,10 @@ class CheckpointSaver:
             self._arena_meta[step] = _arena_meta_of(state)
         except Exception:
             logger.exception("arena metadata capture failed")
+        self._produced_meta[step] = {
+            "model_step": step,
+            "produced_unix_s": round(float(self._clock()), 6),
+        }
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
@@ -281,6 +308,11 @@ class CheckpointSaver:
         # are all float32)
         if step in self._arena_meta:
             manifest["arena"] = self._arena_meta[step]
+        # producer model_step + wall time (absent for steps written by a
+        # pre-freshness trainer); the reloader carries it through the
+        # serving swap so every replica knows the age of its model
+        if step in self._produced_meta:
+            manifest["produced"] = self._produced_meta[step]
         path = self._manifest_path(step)
         tmp = path + ".tmp"
         # temp file + os.replace: readers only ever see a complete
@@ -328,6 +360,13 @@ class CheckpointSaver:
         to; Orbax caches its step listing per manager)."""
         if hasattr(self._mngr, "reload"):
             self._mngr.reload()
+
+    # ---- freshness -----------------------------------------------------
+
+    def produced_meta(self, step: int) -> Optional[Dict[str, Any]]:
+        """The {model_step, produced_unix_s} stamp a manifest recorded
+        for `step`, or None (pre-freshness checkpoints)."""
+        return read_produced_meta(self._dir, step)
 
     # ---- arena dtype compatibility -------------------------------------
 

@@ -144,6 +144,9 @@ class Master:
         self.recovery_clock = None
         self.policy_engine = None
         self.serving_fleet = None
+        self.freshness = None
+        self.metric_history = None
+        self.slo_evaluator = None
         self._k8s = k8s_client
         if k8s_client is not None:
             from elasticdl_tpu.master.pod_manager import PodManager
@@ -203,17 +206,54 @@ class Master:
             self.pod_manager is not None
             and getattr(args, "serving_replicas", 0) > 0
         ):
+            from elasticdl_tpu.master.freshness import FreshnessTracker
             from elasticdl_tpu.master.serving_fleet import (
                 ServingFleetConfig,
                 ServingFleetManager,
             )
 
+            # Train-to-serve freshness: the manifest's own producer
+            # stamp when a checkpoint dir is configured, observation
+            # time otherwise.
+            ckpt_dir = getattr(args, "checkpoint_dir", "")
+            produced_time_fn = None
+            if ckpt_dir:
+                from elasticdl_tpu.common import save_utils
+
+                def produced_time_fn(step, _dir=ckpt_dir):
+                    meta = save_utils.read_produced_meta(_dir, step)
+                    return meta.get("produced_unix_s") if meta else None
+
+            self.freshness = FreshnessTracker(
+                produced_time_fn=produced_time_fn
+            )
             self.serving_fleet = ServingFleetManager(
                 k8s_client,
                 ServingFleetConfig.from_args(args),
                 job_name=args.job_name,
                 image=getattr(args, "image_name", ""),
                 command_fn=self._serving_command,
+                freshness=self.freshness,
+            )
+        # Metric history + SLO judgment (docs/OBSERVABILITY.md "Metric
+        # history & SLOs"): constructed when either loop is enabled so
+        # `elasticdl slo` has evidence to render; `0=off` keeps both
+        # threads parked exactly like the policy engine.
+        history_interval = float(getattr(args, "history_interval", 0.0))
+        slo_interval = float(getattr(args, "slo_interval", 0.0))
+        if history_interval > 0 or slo_interval > 0:
+            from elasticdl_tpu.common.history import MetricHistory
+            from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
+
+            self.metric_history = MetricHistory(
+                registries=self.telemetry_registries(),
+                capacity=int(getattr(args, "history_capacity", 512)),
+                interval_s=history_interval,
+            )
+            self.slo_evaluator = SloEvaluator(
+                self.metric_history,
+                specs=shipped_specs(args),
+                interval_s=slo_interval,
             )
         self._grpc_server = None
         self._done = threading.Event()
@@ -343,6 +383,16 @@ class Master:
                 self.serving_fleet.config.replicas,
                 self.serving_fleet.config.interval_s,
             )
+        if self.metric_history is not None and self.metric_history.start():
+            logger.info(
+                "Metric history sampling every %.1fs",
+                self.metric_history.interval_s,
+            )
+        if self.slo_evaluator is not None and self.slo_evaluator.start():
+            logger.info(
+                "SLO evaluator ticking every %.1fs",
+                self.slo_evaluator.interval_s,
+            )
         # A restored task journal may already be terminal (all shards of
         # the final epoch done): no worker report will ever drain the
         # queue, so give the finish check one proactive run.
@@ -443,6 +493,13 @@ class Master:
             out["policy"] = self.policy_engine.snapshot()
         if self.serving_fleet is not None:
             out["serving_fleet"] = self.serving_fleet.snapshot()
+        if self.freshness is not None:
+            out["freshness"] = self.freshness.snapshot()
+        if self.slo_evaluator is not None:
+            slo = self.slo_evaluator.snapshot()
+            if self.metric_history is not None:
+                slo["history"] = self.metric_history.snapshot()
+            out["slo"] = slo
         out["workers"] = self.servicer.worker_telemetry()
         # Straggler stats come from the task manager's lease clock, not
         # from worker self-reports — merge them onto the same per-worker
@@ -470,6 +527,10 @@ class Master:
             registries.append(self.policy_engine.metrics_registry)
         if self.serving_fleet is not None:
             registries.append(self.serving_fleet.metrics_registry)
+        if self.freshness is not None:
+            registries.append(self.freshness.metrics_registry)
+        if self.slo_evaluator is not None:
+            registries.append(self.slo_evaluator.metrics_registry)
         return registries
 
     def start_telemetry(self, port: int = 0) -> Optional[int]:
@@ -503,6 +564,10 @@ class Master:
             return None
 
     def stop(self):
+        if self.slo_evaluator is not None:
+            self.slo_evaluator.stop()
+        if self.metric_history is not None:
+            self.metric_history.stop()
         if self.policy_engine is not None:
             self.policy_engine.stop()
         if self.serving_fleet is not None:

@@ -143,6 +143,7 @@ class ServingFleetManager:
         pending_step_fn: Optional[Callable[[], Optional[int]]] = None,
         router=None,
         clock: Callable[[], float] = time.time,
+        freshness=None,
     ):
         self._k8s = k8s_client
         self.config = config
@@ -154,6 +155,10 @@ class ServingFleetManager:
         self._pending_step_fn = pending_step_fn
         self._router = router
         self._clock = clock
+        # master/freshness.py FreshnessTracker: every pending-step probe
+        # that reveals a newer checkpoint advances the latest-produced
+        # reference the router scores Predict responses against
+        self._freshness = freshness
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -416,6 +421,7 @@ class ServingFleetManager:
         health_metrics = {m.name: m.value for m in response.metrics}
         rep.fill_ratio = float(health_metrics.get("batch_fill_ratio", 0.0))
         rep.shed = int(health_metrics.get("shed", 0))
+        produced = health_metrics.get("produced_unix_s")
         if self._router is not None:
             self._router.mark_live(rep.replica_id)
             self._router.observe_health(
@@ -423,6 +429,7 @@ class ServingFleetManager:
                 fill_ratio=rep.fill_ratio,
                 queue_depth=rep.queue_depth,
                 model_step=rep.model_step,
+                produced_unix_s=produced,
             )
         return None
 
@@ -448,6 +455,8 @@ class ServingFleetManager:
         except Exception:
             logger.exception("pending-step probe failed")
             return None
+        if target is not None and self._freshness is not None:
+            self._freshness.note_produced(int(target))
         if target is None or target in self._refused_targets:
             return None
         steps = {

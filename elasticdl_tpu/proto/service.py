@@ -268,6 +268,11 @@ _fleet_failovers_counter = _metrics.default_registry().counter(
     "requests re-offered to another replica, by reason",
     labelnames=("reason",),
 )
+_fleet_request_errors_counter = _metrics.default_registry().counter(
+    "rpc_fleet_request_errors_total",
+    "Predict requests that failed after every replica and retry was "
+    "exhausted — the bad events of the predict_availability SLO",
+)
 
 #: In-band codes the router treats as routing signals: the replica is up
 #: but refusing load, so re-offer elsewhere — never re-offer through the
@@ -304,21 +309,27 @@ class FleetRouter:
       load and a loaded replica drains before it sheds.
     """
 
-    def __init__(self, clients=None, retry_policy=None):
+    def __init__(self, clients=None, retry_policy=None, freshness=None):
         if retry_policy is None:
             from elasticdl_tpu.common.resilience import default_policy
 
             retry_policy = default_policy()
         self._retry_policy = retry_policy
+        # master/freshness.py FreshnessTracker: when present, every
+        # successful response's echoed model_step is scored against the
+        # latest produced checkpoint (train-to-serve staleness)
+        self._freshness = freshness
         self._lock = threading.Lock()
         self._clients = dict(clients or {})
         self._penalty = {rid: 0 for rid in self._clients}
         self._fill = {rid: 0.0 for rid in self._clients}
         self._down = set()
         self._steps = {}
+        self._produced = {}
         self._rr = 0
         self._max_skew = 0
         self._failovers = {"error": 0, "overloaded": 0, "shutdown": 0}
+        self._last_staleness = (0, 0.0)
 
     # ---- fleet membership (driven by the ServingFleetManager) ---------
 
@@ -337,6 +348,7 @@ class FleetRouter:
             self._penalty.pop(replica_id, None)
             self._fill.pop(replica_id, None)
             self._steps.pop(replica_id, None)
+            self._produced.pop(replica_id, None)
             self._down.discard(replica_id)
 
     def mark_down(self, replica_id) -> None:
@@ -351,9 +363,11 @@ class FleetRouter:
             self._penalty[replica_id] = 0
 
     def observe_health(self, replica_id, fill_ratio=0.0, queue_depth=0,
-                       model_step=None) -> None:
+                       model_step=None, produced_unix_s=None) -> None:
         """Feed one probe result into the ranking (fill-ratio weighting)
-        and the cross-replica skew bookkeeping."""
+        and the cross-replica skew/freshness bookkeeping.
+        `produced_unix_s` is the producer stamp the replica's engine
+        carries for its served checkpoint (end-to-end freshness)."""
         del queue_depth  # fill-ratio is the load signal; depth rides along
         with self._lock:
             if replica_id not in self._clients:
@@ -361,6 +375,8 @@ class FleetRouter:
             self._fill[replica_id] = float(fill_ratio)
             if model_step is not None:
                 self._note_step_locked(replica_id, int(model_step))
+            if produced_unix_s is not None:
+                self._produced[replica_id] = float(produced_unix_s)
 
     def replica_ids(self):
         with self._lock:
@@ -393,6 +409,9 @@ class FleetRouter:
                 "down": sorted(self._down),
                 "failovers": dict(self._failovers),
                 "max_model_step_skew": self._max_skew,
+                "last_staleness_steps": self._last_staleness[0],
+                "last_staleness_seconds": self._last_staleness[1],
+                "produced_unix_s": dict(self._produced),
             }
 
     # ---- routing ------------------------------------------------------
@@ -456,6 +475,12 @@ class FleetRouter:
             with self._lock:
                 self._penalty[rid] = 0
                 self._note_step_locked(rid, int(response.model_step))
+            if self._freshness is not None:
+                steps, seconds = self._freshness.observe_response(
+                    int(response.model_step)
+                )
+                with self._lock:
+                    self._last_staleness = (steps, round(seconds, 6))
             return response
         if shed_response is not None:
             return shed_response
@@ -466,7 +491,11 @@ class FleetRouter:
         is a full fleet sweep, so backoff only happens when no replica
         could take the request at all."""
         _fleet_requests_counter.inc()
-        return self._retry_policy.call(
-            lambda: self._sweep(request, timeout=timeout),
-            description="fleet_predict",
-        )
+        try:
+            return self._retry_policy.call(
+                lambda: self._sweep(request, timeout=timeout),
+                description="fleet_predict",
+            )
+        except Exception:
+            _fleet_request_errors_counter.inc()
+            raise

@@ -103,12 +103,17 @@ class ServingEngine:
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         precompile: bool = True,
         state_template: Any = None,
+        produced_unix_s: Optional[float] = None,
     ):
         if not buckets or any(b <= 0 for b in buckets):
             raise ValueError(f"buckets must be positive: {buckets}")
         self._model = model
         self._variables = variables
         self._step = int(step)
+        # wall time the producer stamped into the checkpoint manifest
+        # (None for exports / pre-freshness checkpoints); rides the
+        # Health RPC so the master can trace end-to-end staleness
+        self._produced_unix_s = produced_unix_s
         self._feature_spec = dict(feature_spec)
         self._buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._single = set(self._feature_spec) == {SINGLE_FEATURE_KEY}
@@ -249,6 +254,7 @@ class ServingEngine:
                     f"checkpoint step {step} in {checkpoint_dir} failed "
                     "integrity verification or does not exist"
                 )
+            produced = saver.produced_meta(step) or {}
         finally:
             saver.close()
         variables = {**restored.params, **restored.model_state}
@@ -256,6 +262,7 @@ class ServingEngine:
             spec.model, variables, step=int(step),
             feature_spec=feature_meta(sample_features), buckets=buckets,
             precompile=precompile, state_template=template,
+            produced_unix_s=produced.get("produced_unix_s"),
         )
 
     # ---- introspection --------------------------------------------------
@@ -284,6 +291,12 @@ class ServingEngine:
     def step(self) -> int:
         with self._lock:
             return self._step
+
+    @property
+    def produced_unix_s(self) -> Optional[float]:
+        """Producer wall-time stamp of the served checkpoint, or None."""
+        with self._lock:
+            return self._produced_unix_s
 
     def bucket_for(self, rows: int) -> Optional[int]:
         for b in self._buckets:
@@ -381,11 +394,14 @@ class ServingEngine:
 
     # ---- hot reload -----------------------------------------------------
 
-    def swap(self, variables: Dict[str, Any], step: int) -> None:
+    def swap(self, variables: Dict[str, Any], step: int,
+             produced_unix_s: Optional[float] = None) -> None:
         """Atomically replace the served variables.  The new tree must
         match the current one in structure/shape/dtype — the jitted
         buckets were compiled against those avals, and a mismatch would
-        force a recompile (or worse, wrong results) mid-traffic."""
+        force a recompile (or worse, wrong results) mid-traffic.
+        `produced_unix_s` is the manifest's producer stamp (freshness
+        tracing); None keeps no stamp for the new generation."""
         new_shapes = jax.eval_shape(lambda t: t, variables)
         # Check-and-set under one lock hold: reading self._variables for
         # the shape check outside it would let two concurrent swaps
@@ -401,6 +417,7 @@ class ServingEngine:
                 )
             self._variables = variables
             self._step = int(step)
+            self._produced_unix_s = produced_unix_s
         self._swaps.inc()
         logger.info("serving engine swapped to step %d", step)
 

@@ -87,8 +87,10 @@ class CheckpointReloader:
             )
             if restored is None:
                 raise RuntimeError(f"step {latest} could not be restored")
+            produced = self._saver.produced_meta(latest) or {}
             self._engine.swap(
-                {**restored.params, **restored.model_state}, latest
+                {**restored.params, **restored.model_state}, latest,
+                produced_unix_s=produced.get("produced_unix_s"),
             )
         except Exception as exc:
             self._rejected_steps.add(latest)

@@ -132,6 +132,12 @@ class ServingServicer:
         )
         metrics = dict(self._batcher.metrics.snapshot())
         metrics["swap_count"] = float(self._engine.swap_count)
+        # producer wall-time stamp of the served checkpoint (0.0 when
+        # unknown) — rides the scalar-metric list so the fleet manager's
+        # probe can trace end-to-end freshness without a proto change
+        produced = getattr(self._engine, "produced_unix_s", None)
+        if produced is not None:
+            metrics["produced_unix_s"] = float(produced)
         if self._reloader is not None:
             metrics["reload_count"] = float(self._reloader.reload_count)
             metrics["reload_rejected"] = float(

@@ -20,6 +20,12 @@ markdown table on the docs side:
 3. **Span events.**  The table whose first header cell is `event` vs
    the UPPERCASE string constants in `elasticdl_tpu/common/events.py`
    (the VOCABULARY members; `ENV_*` wires are not events).
+4. **SLO vocabulary.**  The table in docs/OBSERVABILITY.md whose first
+   header cell is `slo` vs the `SLO_*` string constants in
+   `elasticdl_tpu/common/slo.py` (the SLO_NAMES members).  An SLO the
+   evaluator judges but the runbook does not explain leaves the
+   on-call reading a breach alert with no objective; a documented SLO
+   the code dropped is a promise nobody measures.
 
 Doc-side findings anchor at the doc line; code-side findings anchor at
 the defining assignment / creation call, so `path:line: GL-DRIFT ...`
@@ -39,6 +45,7 @@ RULE_ID = "GL-DRIFT"
 
 FAULTS_MODULE = "elasticdl_tpu/common/faults.py"
 EVENTS_MODULE = "elasticdl_tpu/common/events.py"
+SLO_MODULE = "elasticdl_tpu/common/slo.py"
 ROBUSTNESS_DOC = "docs/ROBUSTNESS.md"
 OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
 
@@ -129,6 +136,20 @@ def doc_span_events(text: str) -> Optional[Dict[str, int]]:
     return None
 
 
+def doc_slo_vocabulary(text: str) -> Optional[Dict[str, int]]:
+    """{slo name: doc line} from the SLO table, or None when the table
+    is missing."""
+    for header, rows in iter_tables(text):
+        if _first_header(header) != "slo":
+            continue
+        out: Dict[str, int] = {}
+        for lineno, cell in rows:
+            for token in _BACKTICK_RE.findall(cell):
+                out.setdefault(token, lineno)
+        return out
+    return None
+
+
 def _string_constants(
     tree: ast.AST, name_filter,
 ) -> Dict[str, int]:
@@ -162,6 +183,17 @@ def code_span_events(project: Project) -> Optional[Dict[str, int]]:
     return _string_constants(
         pf.tree,
         lambda name: name.isupper() and not name.startswith("ENV_"),
+    )
+
+
+def code_slo_names(project: Project) -> Optional[Dict[str, int]]:
+    pf = project.file(SLO_MODULE)
+    if pf is None or pf.tree is None:
+        return None
+    # STATE_*/KINDS deliberately sit outside the SLO_ prefix: only the
+    # closed SLO-name vocabulary is a doc contract.
+    return _string_constants(
+        pf.tree, lambda name: name.startswith("SLO_")
     )
 
 
@@ -226,6 +258,7 @@ class DriftRule(Rule):
     def check_project(self, project: Project) -> Iterable[Finding]:
         yield from self._check_faults(project)
         yield from self._check_metrics_and_events(project)
+        yield from self._check_slos(project)
 
     # ---- fault points ---------------------------------------------------
 
@@ -339,6 +372,39 @@ class DriftRule(Rule):
                     EVENTS_MODULE, lineno, self.id,
                     f"span event {name!r} is missing from the "
                     f"span-event table in {OBSERVABILITY_DOC}",
+                )
+
+    # ---- SLO vocabulary -------------------------------------------------
+
+    def _check_slos(self, project: Project) -> Iterable[Finding]:
+        slos = code_slo_names(project)
+        if slos is None:
+            return  # slo.py outside the scanned set: nothing to check
+        text = project.read_doc(OBSERVABILITY_DOC)
+        if text is None:
+            # _check_metrics_and_events already reported the missing doc
+            return
+        documented = doc_slo_vocabulary(text)
+        if documented is None:
+            yield Finding(
+                OBSERVABILITY_DOC, 1, self.id,
+                "no SLO table (first header cell `slo`) found — the "
+                "SLO vocabulary in common/slo.py is undocumented",
+            )
+            return
+        for name, lineno in sorted(documented.items()):
+            if name not in slos:
+                yield Finding(
+                    OBSERVABILITY_DOC, lineno, self.id,
+                    f"documents SLO {name!r} that common/slo.py does "
+                    "not define",
+                )
+        for name, lineno in sorted(slos.items()):
+            if name not in documented:
+                yield Finding(
+                    SLO_MODULE, lineno, self.id,
+                    f"SLO {name!r} is missing from the SLO table in "
+                    f"{OBSERVABILITY_DOC}",
                 )
 
 

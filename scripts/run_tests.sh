@@ -2,13 +2,45 @@
 # CI entry point (SURVEY.md C23 parity): static analysis first (fast,
 # no device), then unit + in-process integration tests on a virtual
 # 8-device CPU mesh, then the native-component build.
-set -euo pipefail
+#
+# Always ends with one machine-readable line:
+#   TIER1_SUMMARY passed=<N> wall_s=<S> lint_findings=<L> status=<ok|fail>
+# so CI (and the roadmap driver) can scrape the tier-1 outcome without
+# parsing pytest's human output.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
-# The single lint gate: all seven graftlint rules in one process
+# The single lint gate: all graftlint rules in one process
 # (docs/LINTS.md).  The legacy check_*.py scripts remain as shims over
 # the same rules, so running them separately here would be redundant.
-python -m scripts.graftlint
+lint_json=$(python -m scripts.graftlint --json 2>&1)
+lint_rc=$?
+lint_findings=$(printf '%s' "$lint_json" \
+  | python -c 'import json,sys
+try:
+    print(json.load(sys.stdin).get("count", -1))
+except Exception:
+    print(-1)')
+if [ "$lint_rc" -ne 0 ]; then
+  printf '%s\n' "$lint_json"
+fi
 
 make -C native
-python -m pytest tests/ -q "$@"
+make_rc=$?
+
+start_s=$SECONDS
+pytest_log=$(mktemp)
+python -m pytest tests/ -q "$@" 2>&1 | tee "$pytest_log"
+pytest_rc=${PIPESTATUS[0]}
+wall_s=$((SECONDS - start_s))
+passed=$(grep -Eo '[0-9]+ passed' "$pytest_log" | tail -1 | grep -Eo '[0-9]+' || echo 0)
+rm -f "$pytest_log"
+
+status=ok
+rc=0
+if [ "$lint_rc" -ne 0 ] || [ "$make_rc" -ne 0 ] || [ "$pytest_rc" -ne 0 ]; then
+  status=fail
+  rc=1
+fi
+echo "TIER1_SUMMARY passed=${passed} wall_s=${wall_s} lint_findings=${lint_findings} status=${status}"
+exit "$rc"

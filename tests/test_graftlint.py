@@ -433,6 +433,41 @@ def test_drift_flags_abbreviated_catalogue_rows():
     assert any("abbreviated" in f.message for f in found), found
 
 
+def test_drift_detects_slo_vocabulary_drift():
+    # rename a row in the SLO table: the stale doc name flags at the doc
+    # line, the now-undocumented SLO_* constant flags at common/slo.py
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as fh:
+        text = fh.read()
+    text = text.replace("| `fleet_skew` | gauge |", "| `fleet_skue` | gauge |")
+    project = _drift_project(
+        doc_overrides={"docs/OBSERVABILITY.md": text}
+    )
+    found = list(rules_drift.DriftRule().check_project(project))
+    assert any(
+        "fleet_skue" in f.message and f.path == "docs/OBSERVABILITY.md"
+        for f in found
+    ), found
+    assert any(
+        "fleet_skew" in f.message
+        and f.path == "elasticdl_tpu/common/slo.py"
+        for f in found
+    ), found
+
+
+def test_drift_flags_missing_slo_table():
+    # docs without any `| slo |` table: one finding, not silence — the
+    # vocabulary contract needs the table to exist at all
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as fh:
+        text = fh.read()
+    text = text.replace("| slo | kind | objective | evidence series |",
+                        "| objective | kind | evidence series |")
+    project = _drift_project(
+        doc_overrides={"docs/OBSERVABILITY.md": text}
+    )
+    found = list(rules_drift.DriftRule().check_project(project))
+    assert any("no SLO table" in f.message for f in found), found
+
+
 def test_drift_skipped_on_partial_scan():
     # scanning one file must not compare the full docs against an
     # almost-empty code inventory

@@ -13,12 +13,23 @@ import numpy as np
 import pytest
 
 from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.history import MetricHistory
 from elasticdl_tpu.common.k8s_client import FakeK8sClient
 from elasticdl_tpu.common.model_handler import get_model_spec
 from elasticdl_tpu.common.resilience import RetryPolicy
 from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.common.slo import (
+    SLO_STALENESS_P99,
+    STATE_BREACH,
+    STATE_OK,
+    SloEvaluator,
+    SloSpec,
+    shipped_specs,
+)
+from elasticdl_tpu.master.freshness import FreshnessTracker
 from elasticdl_tpu.master.serving_fleet import (
     ServingFleetConfig,
     ServingFleetManager,
@@ -90,7 +101,8 @@ class _Fleet:
     checkpoint dir, a FleetRouter, and a tick-driven ServingFleetManager
     wired through injectable collaborators — no sockets, no pods."""
 
-    def __init__(self, tmp_path, skew_slo=0, probe_failures=2):
+    def __init__(self, tmp_path, skew_slo=0, probe_failures=2,
+                 with_freshness=False):
         self.spec = get_model_spec("model_zoo", MODEL_DEF)
         self.sample = np.random.RandomState(0).rand(2, 784).astype(
             np.float32
@@ -123,7 +135,14 @@ class _Fleet:
 
         self.k8s = FakeK8sClient()
         self.clock = FakeClock()
-        self.router = FleetRouter(retry_policy=_no_sleep_policy())
+        # End-to-end freshness on the fake clock: the staleness the
+        # router scores per response is fully tick-determined.
+        self.freshness = (
+            FreshnessTracker(clock=self.clock) if with_freshness else None
+        )
+        self.router = FleetRouter(
+            retry_policy=_no_sleep_policy(), freshness=self.freshness
+        )
         self.manager = ServingFleetManager(
             self.k8s,
             ServingFleetConfig(
@@ -136,6 +155,7 @@ class _Fleet:
             pending_step_fn=lambda: self.latest_step,
             router=self.router,
             clock=self.clock,
+            freshness=self.freshness,
         )
         self.manager.place()
         self.request = make_predict_request(self.sample)
@@ -435,3 +455,236 @@ def test_chaos_fleet_traces_are_byte_stable(tmp_path):
     assert run_a["events"] == run_b["events"]
     assert run_a["trace"] == run_b["trace"]
     assert run_a["codes"] == run_b["codes"]
+
+
+# ---- train-to-serve staleness SLO under a reload stall -------------------
+
+_SLO_EVENTS = ("slo_breach", "slo_recovered", "fleet_reload_step")
+
+
+def _slo_event_projection(evts):
+    """Staleness-scenario span events minus the run-variant fields."""
+    return json.dumps(
+        [
+            {k: v for k, v in e.items() if k not in ("ts", "pid")}
+            for e in evts
+            if e.get("event") in _SLO_EVENTS
+        ],
+        sort_keys=True,
+    )
+
+
+def _staleness_spec():
+    # Windows sized for a FakeClock run: 2s staleness objective, and the
+    # slow window deliberately equals the fast window — with the default
+    # 600s slow window the stall's observations would pin the slow burn
+    # over threshold for the whole test and recovery could never fire.
+    return SloSpec(
+        name=SLO_STALENESS_P99, kind="histogram",
+        series="master_train_to_serve_staleness_seconds",
+        objective=2.0, fast_window_s=8.0, slow_window_s=8.0,
+        fast_burn=10.0, slow_burn=10.0,
+    )
+
+
+def _staleness_chaos_run(tmp_path, event_log):
+    """One deterministic staleness burn: step 5 is produced at tick 4 but
+    every sequenced swap aborts for six ticks (fleet.reload_step hits
+    0-5), so responses keep serving step 1 while the produced stamp ages
+    on the fake clock.  The windowed p99 crosses the 2s objective, the
+    fast burn crosses 10x, `slo_breach` fires; once the retried swaps
+    land and the stall's observations age out of the 8s window,
+    `slo_recovered` closes the loop.  Client traffic rides through."""
+    events.configure(event_log, role="master")
+    f = _Fleet(tmp_path, skew_slo=0, with_freshness=True)
+    history = MetricHistory(
+        registries=[f.freshness.metrics_registry], clock=f.clock
+    )
+    evaluator = SloEvaluator(
+        history, specs=[_staleness_spec()], clock=f.clock
+    )
+    reg = faults.install(FaultRegistry(
+        [
+            FaultSpec(faults.POINT_FLEET_RELOAD_STEP, h, "raise")
+            for h in range(6)
+        ],
+        seed=SEED,
+    ))
+    reg.note("scenario", "reload-stall-burns-staleness-slo")
+    try:
+        codes = []
+        states = []
+        for tick in range(1, 27):
+            if tick == 4:
+                f.save_step(5, scale=2.0)
+            f.step_tick()
+            codes.append(f.router.predict(f.request).code)
+            history.tick()
+            evaluator.tick()
+            states.append(evaluator.state(SLO_STALENESS_P99))
+        decisions = {
+            "fleet": list(f.manager.decisions),
+            "slo": list(evaluator.decisions),
+        }
+        freshness = f.freshness.snapshot()
+    finally:
+        f.close()
+        faults.uninstall()
+        events.configure(None)
+    return {
+        "codes": codes,
+        "states": states,
+        "freshness": freshness,
+        "decisions_json": json.dumps(decisions, sort_keys=True),
+        "events": _slo_event_projection(events.read_events(event_log)),
+        "trace": reg.trace_text(),
+        "registry": reg,
+    }
+
+
+def test_staleness_slo_burns_and_recovers_under_reload_stall(tmp_path):
+    run = _staleness_chaos_run(tmp_path / "run_a", str(tmp_path / "a.jsonl"))
+
+    # every scheduled reload abort fired, and not one request failed
+    assert run["registry"].all_fired(), run["registry"].unfired()
+    assert run["codes"] == [spb.SERVING_OK] * 26
+
+    decisions = json.loads(run["decisions_json"])
+    fleet_actions = [d["action"] for d in decisions["fleet"]]
+    assert fleet_actions == ["reload_aborted"] * 6 + ["reload_step"] * 3
+
+    # the stall provably burned the SLO, then it provably recovered
+    slo_events = [d["event"] for d in decisions["slo"]]
+    assert slo_events == ["slo_breach", "slo_recovered"]
+    breach, recovered = decisions["slo"]
+    assert breach["slo"] == SLO_STALENESS_P99
+    assert breach["fast_burn"] >= 10.0
+    assert recovered["fast_burn"] < 1.0  # hysteresis: inside budget again
+
+    # state timeline: ok while fresh, breach during the stall, ok only
+    # after the bad observations aged out of the 8s fast window
+    assert run["states"][0] == STATE_OK
+    assert run["states"][-1] == STATE_OK
+    assert STATE_BREACH in run["states"]
+    assert run["states"].index(STATE_BREACH) <= 6
+    assert run["states"].count(STATE_BREACH) >= 8
+
+    # breach/recovery reached the span-event stream alongside the swaps
+    names = [e["event"] for e in json.loads(run["events"])]
+    assert names.count("slo_breach") == 1
+    assert names.count("slo_recovered") == 1
+    assert names.count("fleet_reload_step") == 3
+
+    # the end-to-end freshness evidence behind the judgment
+    assert run["freshness"]["latest_step"] == 5
+    assert run["freshness"]["observations"] == 26
+    assert run["freshness"]["staleness_p99_s"] > 2.0
+
+
+def test_staleness_slo_trace_is_byte_stable(tmp_path):
+    run_a = _staleness_chaos_run(
+        tmp_path / "run_a", str(tmp_path / "a.jsonl")
+    )
+    run_b = _staleness_chaos_run(
+        tmp_path / "run_b", str(tmp_path / "b.jsonl")
+    )
+    assert run_a["decisions_json"] == run_b["decisions_json"]
+    assert run_a["events"] == run_b["events"]
+    assert run_a["trace"] == run_b["trace"]
+    assert run_a["states"] == run_b["states"]
+    assert run_a["codes"] == run_b["codes"]
+
+
+# ---- `elasticdl slo` against a live fleet --------------------------------
+
+
+def test_elasticdl_slo_reports_live_fleet(tmp_path, capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.client.slo import render_slo
+    from elasticdl_tpu.common.telemetry import TelemetryServer
+
+    f = _Fleet(tmp_path, skew_slo=10, with_freshness=True)
+    # the three shipped SLOs draw on three registries: freshness
+    # histograms, the manager's skew gauge, and the process-global fleet
+    # request counters the router increments
+    history = MetricHistory(
+        registries=[
+            f.freshness.metrics_registry,
+            f.manager.metrics_registry,
+            metrics_lib.default_registry(),
+        ],
+        clock=f.clock,
+    )
+    evaluator = SloEvaluator(history, specs=shipped_specs(), clock=f.clock)
+    try:
+        for _ in range(3):
+            f.step_tick()
+            assert f.router.predict(f.request).code == spb.SERVING_OK
+            history.tick()
+            evaluator.tick()
+        payload = evaluator.snapshot()
+        payload["history"] = history.snapshot()
+    finally:
+        f.close()
+
+    # every shipped SLO judged with window evidence from the live run
+    assert [row["slo"] for row in payload["slos"]] == [
+        s.name for s in shipped_specs()
+    ]
+    assert all(row["state"] == STATE_OK for row in payload["slos"])
+
+    server = TelemetryServer(
+        registries=[evaluator.metrics_registry],
+        role="master",
+        host="127.0.0.1",
+        varz_fn=lambda: {"snapshot": {"slo": payload}},
+    )
+    port = server.start()
+    try:
+        rc = cli_main(["slo", f"127.0.0.1:{port}"])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        # the CLI prints the exact bytes render_slo produces in-process
+        assert printed.rstrip("\n") == render_slo(payload)
+        for name in ("staleness_p99", "fleet_skew", "predict_availability"):
+            assert name in printed
+        assert "OK" in printed
+        assert "history:" in printed
+
+        rc = cli_main(["slo", f"127.0.0.1:{port}", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["states"] == {
+            "staleness_p99": "ok",
+            "fleet_skew": "ok",
+            "predict_availability": "ok",
+        }
+    finally:
+        server.stop()
+
+
+def test_elasticdl_slo_reports_unreachable_master(capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    rc = cli_main(["slo", "127.0.0.1:1"])  # nothing listens on port 1
+    assert rc == 1
+    assert "cannot scrape" in capsys.readouterr().err
+
+
+def test_elasticdl_slo_reports_missing_evaluator(capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+    from elasticdl_tpu.common.telemetry import TelemetryServer
+
+    server = TelemetryServer(
+        registries=[],
+        role="master",
+        host="127.0.0.1",
+        varz_fn=lambda: {"snapshot": {}},
+    )
+    port = server.start()
+    try:
+        rc = cli_main(["slo", f"127.0.0.1:{port}"])
+    finally:
+        server.stop()
+    assert rc == 1
+    assert "no SLO evaluator" in capsys.readouterr().err

@@ -1,0 +1,568 @@
+"""Metric history, SLO burn-rate evaluation, and freshness tracking
+(common/history.py, common/slo.py, master/freshness.py;
+docs/OBSERVABILITY.md "Metric history & SLOs").
+
+Everything runs on hand-ticked fake clocks — the history recorder and
+the SLO evaluator are `interval_s=0` loops exactly like the policy
+engine, so every windowed number below is deterministic.
+"""
+
+import threading
+
+import pytest
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common.history import MetricHistory
+from elasticdl_tpu.common.slo import (
+    SLO_FLEET_SKEW,
+    SLO_NAMES,
+    SLO_PREDICT_AVAILABILITY,
+    SLO_STALENESS_P99,
+    STATE_BREACH,
+    STATE_NO_DATA,
+    STATE_OK,
+    SloEvaluator,
+    SloSpec,
+    shipped_specs,
+)
+from elasticdl_tpu.master.freshness import FreshnessTracker
+
+
+class FakeClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    yield
+    events.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# MetricHistory: scalar series
+# ---------------------------------------------------------------------------
+
+
+def _history(clock, **kwargs):
+    reg = metrics_lib.MetricsRegistry()
+    return MetricHistory(registries=[reg], clock=clock, **kwargs), reg
+
+
+def test_ring_buffer_evicts_oldest_at_capacity():
+    clock = FakeClock()
+    history, reg = _history(clock, capacity=4)
+    gauge = reg.gauge("master_test_depth_count", "fixture")
+    for value in range(6):
+        gauge.set(float(value))
+        history.tick()
+        clock.advance(1.0)
+    points = history.series("master_test_depth_count")
+    assert len(points) == 4  # capacity bound held
+    assert [v for _, v in points] == [2.0, 3.0, 4.0, 5.0]  # oldest gone
+    assert history.latest("master_test_depth_count") == 5.0
+    assert history.snapshot()["samples"] == 6
+
+
+def test_window_respects_cutoff_and_unknown_series():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    gauge = reg.gauge("master_test_depth_count", "fixture")
+    for value in (1.0, 2.0, 3.0):
+        gauge.set(value)
+        history.tick()
+        clock.advance(10.0)
+    # clock is now at +30; a 25s window keeps the samples at +10 and +20
+    assert [v for _, v in history.window("master_test_depth_count", 25.0)] \
+        == [2.0, 3.0]
+    assert history.window("master_test_nope_count", 25.0) == []
+    assert history.latest("master_test_nope_count") is None
+
+
+def test_counter_delta_is_reset_aware():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    gauge = reg.gauge("master_test_events_count", "fixture")
+    # 5 -> 8 -> 2 -> 4: the drop to 2 is a restart, contributing its
+    # full post-reset value (increase() semantics): 3 + 2 + 2 = 7
+    for value in (5.0, 8.0, 2.0, 4.0):
+        gauge.set(value)
+        history.tick()
+        clock.advance(1.0)
+    assert history.counter_delta("master_test_events_count", 60.0) == 7.0
+
+
+def test_fresh_sampler_sees_no_phantom_delta():
+    # A sampler that starts against an already-large counter must not
+    # report the whole lifetime value as one window's increase.
+    clock = FakeClock()
+    reg = metrics_lib.MetricsRegistry()
+    counter = reg.counter("master_test_events_total", "fixture")
+    counter.inc(100)
+    history = MetricHistory(registries=[reg], clock=clock)
+    history.tick()
+    assert history.counter_delta("master_test_events_total", 60.0) == 0.0
+    clock.advance(1.0)
+    counter.inc(5)
+    history.tick()
+    assert history.counter_delta("master_test_events_total", 60.0) == 5.0
+
+
+def test_rate_and_exceedance_ratio():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    counter = reg.counter("master_test_events_total", "fixture")
+    gauge = reg.gauge("master_test_depth_count", "fixture")
+    for value in (0.0, 4.0, 12.0):
+        # counter rises 12 over the 20s sample span -> 0.6/s
+        while counter.value() < value:
+            counter.inc()
+        gauge.set(value)
+        history.tick()
+        clock.advance(10.0)
+    assert history.rate("master_test_events_total", 60.0) == pytest.approx(
+        12.0 / 20.0
+    )
+    # samples 0/4/12 vs bound 3.0: 2 of 3 strictly over
+    assert history.exceedance_ratio(
+        "master_test_depth_count", 3.0, 60.0
+    ) == pytest.approx(2.0 / 3.0)
+    assert history.exceedance_ratio(
+        "master_test_depth_count", 3.0, 5.0
+    ) is None  # empty window
+    assert history.rate("master_test_events_total", 5.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# MetricHistory: windowed histogram math
+# ---------------------------------------------------------------------------
+
+
+def _seconds_histogram(reg):
+    return reg.histogram(
+        "master_test_wait_seconds", "fixture",
+        min_value=1e-3, max_value=100.0, growth=2.0,
+    )
+
+
+def test_windowed_histogram_quantile_ages_out_old_observations():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    hist = _seconds_histogram(reg)
+    # lifetime starts with fast observations...
+    for _ in range(20):
+        hist.record(0.002)
+    history.tick()
+    clock.advance(100.0)
+    # ...then a slow burst lands inside the window of interest
+    for _ in range(5):
+        hist.record(50.0)
+    history.tick()
+
+    # the flat series is a lifetime aggregate: p50 still fast
+    assert history.latest("master_test_wait_seconds_p50") < 1.0
+    # a window spanning both samples sees only the burst's *deltas* —
+    # the 20 fast pre-window observations are in the cumulative baseline
+    windowed_p50 = history.histogram_quantile(
+        "master_test_wait_seconds", 0.5, 150.0
+    )
+    assert windowed_p50 >= 50.0
+    bad, total = history.histogram_exceedance(
+        "master_test_wait_seconds", 1.0, 150.0
+    )
+    assert (bad, total) == (5, 5)
+    # a window holding a single bucket sample has no deltas yet
+    assert history.histogram_quantile(
+        "master_test_wait_seconds", 0.5, 60.0
+    ) is None
+
+    # with no new observations, later samples age the burst out
+    clock.advance(50.0)
+    history.tick()
+    clock.advance(50.0)
+    history.tick()
+    assert history.histogram_exceedance(
+        "master_test_wait_seconds", 1.0, 90.0
+    ) == (0, 0)
+
+
+def test_histogram_reset_contributes_post_restart_counts():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    hist = _seconds_histogram(reg)
+    for _ in range(10):
+        hist.record(0.002)
+    history.tick()
+    clock.advance(1.0)
+    # simulate the producer process restarting: cumulative counts drop
+    child = hist.child_items()[0][1]
+    with child._lock:
+        child._counts = [0] * len(child._counts)
+        child._total = 0
+        child._sum_s = 0.0
+    hist.record(50.0)
+    hist.record(50.0)
+    history.tick()
+    uppers, deltas, total = history.histogram_window(
+        "master_test_wait_seconds", 60.0
+    )
+    assert total == 2  # the reset never yields negative deltas
+    assert sum(
+        c for u, c in zip(uppers, deltas) if u > 1.0
+    ) == 2
+
+
+def test_unknown_histogram_returns_none():
+    clock = FakeClock()
+    history, _reg = _history(clock)
+    assert history.histogram_window("master_test_wait_seconds", 60.0) is None
+    assert history.histogram_quantile(
+        "master_test_wait_seconds", 0.99, 60.0
+    ) is None
+    assert history.histogram_exceedance(
+        "master_test_wait_seconds", 1.0, 60.0
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# Concurrency: /metrics scrape vs history sampling vs live recording
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_scrape_sampling_and_recording_tear_nothing():
+    """A /metrics render, history.tick(), and live recording race for a
+    while; every sampled counter series must still be monotonic (a torn
+    read would show up as a dip) and every exposition must parse."""
+    reg = metrics_lib.MetricsRegistry()
+    counter = reg.counter("master_test_events_total", "fixture")
+    hist = _seconds_histogram(reg)
+    history = MetricHistory(registries=[reg])
+    stop = threading.Event()
+    errors = []
+
+    def record():
+        while not stop.is_set():
+            counter.inc()
+            hist.record(0.01)
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                text = metrics_lib.render_text([reg])
+                assert "master_test_events_total" in text
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+    def sample():
+        for _ in range(200):
+            try:
+                history.tick()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+        stop.set()
+
+    threads = [
+        threading.Thread(target=fn) for fn in (record, scrape, sample)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors
+    points = [v for _, v in history.series("master_test_events_total")]
+    assert len(points) == 200
+    assert all(a <= b for a, b in zip(points, points[1:]))  # no tears
+    win = history.histogram_window("master_test_wait_seconds", 1e9)
+    assert win is not None and win[2] >= 0
+
+
+def test_background_loops_start_and_stop():
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter("master_test_events_total", "fixture").inc()
+    history = MetricHistory(registries=[reg], interval_s=0.005)
+    assert history.start() is True
+    assert history.start() is False  # already running
+    evaluator = SloEvaluator(
+        history, specs=shipped_specs(), interval_s=0.005
+    )
+    assert evaluator.start() is True
+    try:
+        deadline = threading.Event()
+        deadline.wait(0.05)
+    finally:
+        evaluator.stop()
+        history.stop()
+    assert history.snapshot()["samples"] >= 1
+    assert evaluator.snapshot()["ticks"] >= 1
+    # interval 0: no loop, tests tick by hand (policy-engine contract)
+    assert MetricHistory(registries=[reg]).start() is False
+    assert SloEvaluator(history).start() is False
+
+
+# ---------------------------------------------------------------------------
+# SloEvaluator: burn-rate state machine
+# ---------------------------------------------------------------------------
+
+
+def _gauge_spec(**overrides):
+    kwargs = dict(
+        name=SLO_FLEET_SKEW,
+        kind="gauge",
+        series="serving_fleet_model_step_skew_steps",
+        objective=8.0,
+        target=0.99,
+        fast_window_s=10.0,
+        slow_window_s=10.0,
+        fast_burn=14.0,
+        slow_burn=6.0,
+    )
+    kwargs.update(overrides)
+    return SloSpec(**kwargs)
+
+
+def test_spec_vocabulary_is_closed():
+    assert SLO_NAMES == {
+        SLO_STALENESS_P99, SLO_FLEET_SKEW, SLO_PREDICT_AVAILABILITY,
+    }
+    with pytest.raises(AssertionError):
+        SloSpec(name="made_up", kind="gauge", series="s", objective=1.0)
+    with pytest.raises(AssertionError):
+        SloSpec(name=SLO_FLEET_SKEW, kind="nope", series="s", objective=1.0)
+    with pytest.raises(AssertionError):
+        SloSpec(
+            name=SLO_PREDICT_AVAILABILITY, kind="ratio", series="bad",
+            objective=0.0,
+        )  # ratio needs total_series
+    names = [spec.name for spec in shipped_specs()]
+    assert names == [
+        SLO_STALENESS_P99, SLO_FLEET_SKEW, SLO_PREDICT_AVAILABILITY,
+    ]
+
+
+def test_shipped_specs_read_flags():
+    class Args:
+        slo_staleness_p99_s = 30.0
+        serving_step_skew_slo = 4
+
+    specs = {spec.name: spec for spec in shipped_specs(Args())}
+    assert specs[SLO_STALENESS_P99].objective == 30.0
+    assert specs[SLO_FLEET_SKEW].objective == 4.0
+
+
+def _status_value(evaluator, slo, state):
+    key = metrics_lib._series_key(
+        "master_slo_status_info", (("slo", slo), ("state", state))
+    )
+    return evaluator.metrics_registry.snapshot()[key]
+
+
+def test_gauge_slo_breach_and_recovery_with_hysteresis(tmp_path):
+    event_log = str(tmp_path / "events.jsonl")
+    events.configure(event_log, role="master")
+    clock = FakeClock()
+    history, reg = _history(clock)
+    gauge = reg.gauge("serving_fleet_model_step_skew_steps", "fixture")
+    evaluator = SloEvaluator(
+        history, specs=[_gauge_spec()], clock=clock
+    )
+
+    # no evidence yet
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_NO_DATA
+    assert _status_value(evaluator, SLO_FLEET_SKEW, STATE_NO_DATA) == 1.0
+
+    # healthy samples -> ok
+    for _ in range(3):
+        gauge.set(2.0)
+        history.tick()
+        clock.advance(1.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_OK
+
+    # every sample over the objective: bad_ratio 1.0 / budget 0.01 = 100x
+    for _ in range(10):
+        gauge.set(20.0)
+        history.tick()
+        clock.advance(1.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_BREACH
+    assert _status_value(evaluator, SLO_FLEET_SKEW, STATE_BREACH) == 1.0
+    assert _status_value(evaluator, SLO_FLEET_SKEW, STATE_OK) == 0.0
+    report = {row["slo"]: row for row in evaluator.report()}
+    assert report[SLO_FLEET_SKEW]["fast_burn"] >= 14.0
+    assert evaluator.max_burn() >= 14.0
+
+    # healthy again, but bad samples still inside the 10s window:
+    # burn is under the alert threshold yet over 1.0 -> hysteresis holds
+    for _ in range(6):
+        gauge.set(2.0)
+        history.tick()
+        clock.advance(1.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_BREACH
+
+    # once the window is all-healthy the budget burn is 0 -> recovered
+    for _ in range(10):
+        gauge.set(2.0)
+        history.tick()
+        clock.advance(1.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_OK
+
+    decisions = evaluator.snapshot()["decisions"]
+    assert [d["event"] for d in decisions] == [
+        "slo_breach", "slo_recovered",
+    ]
+    logged = [
+        e for e in events.read_events(event_log)
+        if e["event"] in ("slo_breach", "slo_recovered")
+    ]
+    assert [e["event"] for e in logged] == ["slo_breach", "slo_recovered"]
+    assert logged[0]["slo"] == SLO_FLEET_SKEW
+    assert logged[0]["fast_burn"] >= 14.0
+
+
+def test_data_gap_holds_previous_judgment():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    gauge = reg.gauge("serving_fleet_model_step_skew_steps", "fixture")
+    evaluator = SloEvaluator(history, specs=[_gauge_spec()], clock=clock)
+    for _ in range(10):
+        gauge.set(20.0)
+        history.tick()
+        clock.advance(1.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_BREACH
+    # the sampler stalls: the window empties, but a breach must not
+    # silently become no_data (the alert would vanish mid-incident)
+    clock.advance(100.0)
+    evaluator.tick()
+    assert evaluator.state(SLO_FLEET_SKEW) == STATE_BREACH
+    assert evaluator.snapshot()["decisions"][-1]["event"] == "slo_breach"
+
+
+def test_ratio_slo_counts_error_share():
+    clock = FakeClock()
+    history, reg = _history(clock)
+    total = reg.counter("rpc_fleet_requests_total", "fixture")
+    bad = reg.counter("rpc_fleet_request_errors_total", "fixture")
+    spec = SloSpec(
+        name=SLO_PREDICT_AVAILABILITY,
+        kind="ratio",
+        series="rpc_fleet_request_errors_total",
+        total_series="rpc_fleet_requests_total",
+        objective=0.0,
+        target=0.999,
+        fast_window_s=10.0,
+        slow_window_s=10.0,
+        fast_burn=14.0,
+        slow_burn=6.0,
+    )
+    evaluator = SloEvaluator(history, specs=[spec], clock=clock)
+    evaluator.tick()
+    assert evaluator.state(SLO_PREDICT_AVAILABILITY) == STATE_NO_DATA
+
+    # 100 requests, all good
+    history.tick()
+    clock.advance(1.0)
+    total.inc(100)
+    history.tick()
+    evaluator.tick()
+    assert evaluator.state(SLO_PREDICT_AVAILABILITY) == STATE_OK
+
+    # 10 of the next 100 fail: bad_ratio 0.1 / budget 0.001 = 100x
+    clock.advance(1.0)
+    total.inc(100)
+    bad.inc(10)
+    history.tick()
+    evaluator.tick()
+    assert evaluator.state(SLO_PREDICT_AVAILABILITY) == STATE_BREACH
+
+    # no traffic at all burns nothing and (after the window drains)
+    # the hysteresis gate sees burn 0 -> recovery
+    clock.advance(20.0)
+    history.tick()
+    clock.advance(1.0)
+    history.tick()
+    evaluator.tick()
+    assert evaluator.state(SLO_PREDICT_AVAILABILITY) == STATE_OK
+
+
+# ---------------------------------------------------------------------------
+# FreshnessTracker
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_tracks_latest_and_staleness():
+    clock = FakeClock(start=100.0)
+    tracker = FreshnessTracker(clock=clock)
+    assert tracker.latest() == (0, None)
+    assert tracker.note_produced(10) is True
+    assert tracker.note_produced(10) is False  # no step regression
+    assert tracker.note_produced(7) is False
+    assert tracker.latest() == (10, 100.0)
+
+    clock.advance(5.0)
+    steps, seconds = tracker.observe_response(6)
+    assert steps == 4
+    assert seconds == pytest.approx(5.0)
+    # serving the latest step is fresh by definition
+    assert tracker.observe_response(10) == (0, 0.0)
+
+    snap = tracker.snapshot()
+    assert snap["latest_step"] == 10
+    assert snap["observations"] == 2
+    assert snap["staleness_p99_steps"] > 0
+    assert "produced" not in snap  # clock-free for byte-stable diffs
+
+
+def test_freshness_prefers_manifest_stamp():
+    clock = FakeClock(start=100.0)
+    tracker = FreshnessTracker(
+        clock=clock, produced_time_fn=lambda step: 90.0,
+    )
+    tracker.note_produced(3)
+    assert tracker.latest() == (3, 90.0)  # manifest stamp, not clock
+    tracker.note_produced(4, produced_unix_s=95.0)
+    assert tracker.latest() == (4, 95.0)  # explicit arg wins
+
+    clock.advance(1.0)
+    _steps, seconds = tracker.observe_response(1)
+    assert seconds == pytest.approx(101.0 - 95.0)
+
+
+def test_freshness_feeds_history_and_staleness_slo():
+    clock = FakeClock()
+    tracker = FreshnessTracker(clock=clock)
+    history = MetricHistory(
+        registries=[tracker.metrics_registry], clock=clock
+    )
+    spec = SloSpec(
+        name=SLO_STALENESS_P99,
+        kind="histogram",
+        series="master_train_to_serve_staleness_seconds",
+        objective=2.0,
+        fast_window_s=10.0,
+        slow_window_s=10.0,
+        fast_burn=10.0,
+        slow_burn=10.0,
+    )
+    evaluator = SloEvaluator(history, specs=[spec], clock=clock)
+    tracker.note_produced(5)
+    history.tick()
+    for _ in range(6):
+        clock.advance(1.0)
+        tracker.observe_response(1)  # stale responses, growing age
+        history.tick()
+        evaluator.tick()
+    assert evaluator.state(SLO_STALENESS_P99) == STATE_BREACH
+    assert history.histogram_exceedance(
+        "master_train_to_serve_staleness_seconds", 2.0, 10.0
+    )[0] >= 1

@@ -520,3 +520,55 @@ def test_top_reports_unreachable_master(capsys):
     rc = cli_main(["top", "127.0.0.1:1"])  # nothing listens on port 1
     assert rc == 1
     assert "cannot scrape" in capsys.readouterr().out
+
+
+def test_top_watch_redraws_in_place(capsys):
+    from types import SimpleNamespace
+
+    from elasticdl_tpu.client.top import top
+
+    snapshot = _master_like_snapshot()
+    # an SLO + freshness summary rides the same snapshot when the
+    # master runs the evaluator (docs/OBSERVABILITY.md)
+    snapshot["slo"] = {
+        "states": {"staleness_p99": "breach"},
+        "slos": [
+            {"slo": "staleness_p99", "state": "breach", "fast_burn": 12.5}
+        ],
+    }
+    snapshot["freshness"] = {
+        "latest_step": 5, "observations": 26,
+        "staleness_p50_s": 0.0, "staleness_p99_s": 6.5,
+    }
+    server = TelemetryServer(
+        registries=[metrics_lib.MetricsRegistry()],
+        role="master",
+        host="127.0.0.1",
+        varz_fn=lambda: {"snapshot": snapshot},
+    )
+    port = server.start()
+    sleeps = []
+    try:
+        args = SimpleNamespace(
+            master_varz=f"127.0.0.1:{port}", watch=True,
+            interval_s=0.5, serving_addr="",
+        )
+        rc = top(
+            args, clock=lambda: 0.0, sleep=sleeps.append, max_frames=2
+        )
+    finally:
+        server.stop()
+    assert rc == 0
+    out = capsys.readouterr().out
+    # frame 1 wipes the screen once; frame 2 only homes the cursor and
+    # clears below — in-place redraw, no scrollback spam
+    assert out.startswith("\033[2J\033[H")
+    assert out.count("\033[2J") == 1
+    assert out.count("\033[H") == 2
+    assert out.count("\033[J") == 2
+    assert sleeps == [0.5]  # slept between the two frames, then returned
+    assert "slo: staleness_p99=breach(12.5x)" in out
+    assert (
+        "freshness: latest_step=5 staleness p50=0.00s p99=6.50s obs=26"
+        in out
+    )
