@@ -886,8 +886,12 @@ def bench_serving_fleet(
     sequences one rolling hot-reload (docs/SERVING.md "Fleet").  Reports
     client-observed p50/p99, the failed-request count (the failover
     guarantee says it must be 0), the max observed cross-replica
-    model_step skew vs the SLO, train-to-serve staleness p50/p99, and
-    the max staleness burn rate the SLO evaluator saw during the roll."""
+    model_step skew vs the SLO, train-to-serve staleness p50/p99, the
+    max staleness burn rate the SLO evaluator saw during the roll, the
+    per-phase serve latency breakdown (queue_wait/compute/... p50/p99
+    from the predict_span stream at full sampling), and the router-side
+    tracing overhead (traced vs untraced mean latency over a calm
+    sequential pass — the <2%% budget in docs/OBSERVABILITY.md)."""
     import tempfile
     import threading
     import time
@@ -895,6 +899,7 @@ def bench_serving_fleet(
     import jax
     import jax.numpy as jnp
 
+    from elasticdl_tpu.common import events as events_lib
     from elasticdl_tpu.common.constants import PodStatus
     from elasticdl_tpu.common.history import MetricHistory
     from elasticdl_tpu.common.k8s_client import FakeK8sClient
@@ -990,6 +995,25 @@ def bench_serving_fleet(
             ),
             freshness=freshness,
         )
+        # per-phase serve latency from the predict_span stream (the
+        # router defaults to full sampling): an in-process tap collects
+        # every span's phase durations across all replicas
+        phase_values = {}
+        phase_lock = threading.Lock()
+
+        def collect_span(record):
+            if record.get("event") != events_lib.PREDICT_SPAN:
+                return
+            phases = record.get("phases_s")
+            if not isinstance(phases, dict):
+                return
+            with phase_lock:
+                for phase, seconds in phases.items():
+                    phase_values.setdefault(phase, []).append(
+                        float(seconds)
+                    )
+
+        events_lib.add_observer(collect_span)
         manager = ServingFleetManager(
             k8s,
             ServingFleetConfig(
@@ -1079,6 +1103,40 @@ def bench_serving_fleet(
 
         snap = manager.snapshot()
         stats = router.stats()
+        events_lib.remove_observer(collect_span)
+
+        # Tracing-overhead calibration over the same warm fleet: calm
+        # sequential traffic through a fresh router at full sampling
+        # (with a span tap attached, the worst case) vs sampling off.
+        def mean_latency_s(rate, n=80):
+            probe = FleetRouter(
+                clients={
+                    rid: rep["client"] for rid, rep in fleet.items()
+                },
+                retry_policy=RetryPolicy(
+                    initial_backoff_s=0.001, max_backoff_s=0.01,
+                    max_elapsed_s=30.0, max_attempts=8,
+                ),
+                trace_sample_rate=rate,
+            )
+            x = np.random.RandomState(7).rand(4, 784).astype(np.float32)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                probe.predict(make_predict_request(x))
+            return (time.perf_counter() - t0) / n
+
+        def span_sink(record):
+            pass
+
+        events_lib.add_observer(span_sink)
+        traced_s = mean_latency_s(1.0)
+        events_lib.remove_observer(span_sink)
+        untraced_s = mean_latency_s(0.0)
+        trace_overhead_pct = (
+            (traced_s - untraced_s) / untraced_s * 100.0
+            if untraced_s > 0 else 0.0
+        )
+
         for rep in fleet.values():
             rep["batcher"].shutdown()
         saver.close()
@@ -1108,6 +1166,18 @@ def bench_serving_fleet(
             "staleness_p50_s": staleness["staleness_p50_s"],
             "staleness_p99_s": staleness["staleness_p99_s"],
             "max_burn_rate": round(max_burn[0], 3),
+            "phase_latency_ms": {
+                phase: {
+                    "p50": round(
+                        float(np.percentile(vals, 50)) * 1e3, 3
+                    ),
+                    "p99": round(
+                        float(np.percentile(vals, 99)) * 1e3, 3
+                    ),
+                }
+                for phase, vals in sorted(phase_values.items())
+            },
+            "trace_overhead_pct": round(trace_overhead_pct, 2),
         },
     }
 

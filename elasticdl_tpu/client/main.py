@@ -97,6 +97,14 @@ def _build_parser() -> argparse.ArgumentParser:
     args_lib.add_trace_params(trace_parser)
     trace_parser.set_defaults(func="trace")
 
+    incident_parser = subparsers.add_parser(
+        "incident",
+        help="list incident flight-recorder bundles (--incident_dir of "
+        "the master) or render one into a postmortem report",
+    )
+    args_lib.add_incident_params(incident_parser)
+    incident_parser.set_defaults(func="incident")
+
     zoo_parser = subparsers.add_parser("zoo", help="model zoo image tools")
     zoo_sub = zoo_parser.add_subparsers(dest="zoo_command")
     zoo_init = zoo_sub.add_parser("init", help="scaffold a model zoo dir")
@@ -151,6 +159,10 @@ def main(argv=None) -> int:
         from elasticdl_tpu.client.trace import trace
 
         return trace(args)
+    if args.func == "incident":
+        from elasticdl_tpu.client.incident import incident
+
+        return incident(args)
     if args.func == "zoo_init":
         return image_builder.init_zoo(args.model_zoo, args.base_image)
     if args.func == "zoo_build":

@@ -203,6 +203,8 @@ def render(varz: dict, serving_varz: Optional[dict] = None,
             + "model_step".rjust(12)
             + "fill".rjust(8)
             + "shed".rjust(8)
+            + "qwait_p99".rjust(11)
+            + "comp_p99".rjust(10)
             + "relaunched".rjust(12)
         )
         for rid in sorted(fleet["replicas"], key=lambda r: int(r)):
@@ -214,6 +216,16 @@ def render(varz: dict, serving_varz: Optional[dict] = None,
                 + _fmt(entry.get("model_step", 0), 12)
                 + _fmt(entry.get("fill_ratio", 0.0), 8)
                 + _fmt(entry.get("shed", 0), 8)
+                + _fmt(
+                    "{:.1f}ms".format(
+                        entry.get("queue_wait_p99_s", 0.0) * 1e3
+                    ), 11,
+                )
+                + _fmt(
+                    "{:.1f}ms".format(
+                        entry.get("compute_p99_s", 0.0) * 1e3
+                    ), 10,
+                )
                 + _fmt(entry.get("incarnation", 0), 12)
             )
     if serving_varz is not None:

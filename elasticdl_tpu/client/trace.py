@@ -48,7 +48,17 @@ _INSTANT_EVENTS = frozenset({
     events.SERVING_RELOADED,
     events.STRAGGLER_DETECTED,
     events.STEP_PHASES,
+    events.SLO_BREACH,
+    events.SLO_RECOVERED,
+    events.INCIDENT_CAPTURED,
 })
+
+#: Serve-path phase rendering order (the request's causal hop order —
+#: a subset of events.SPAN_PHASES may be present on any one span).
+_PHASE_ORDER = (
+    "route", "queue_wait", "batch_form", "pad", "compute", "unpack",
+    "respond",
+)
 
 
 def _role_pid(role: str) -> int:
@@ -86,6 +96,44 @@ def task_durations(evts: List[dict]) -> List[Tuple[int, int, float]]:
             (task_id, worker_id, float(last["ts"]) - float(first["ts"]))
         )
     return out
+
+
+def _request_spans(evts: List[dict]) -> Dict[str, dict]:
+    """request_id -> one merged serve-request span.  A routed request
+    can emit up to two predict_span halves — the servicer's (queue/
+    batch/compute/respond phases) and the router's (the route phase +
+    the routing outcome) — correlated here by request_id.  Requests the
+    sampler skipped never minted a wire request_id, so they are simply
+    absent."""
+    spans: Dict[str, dict] = {}
+    for e in evts:
+        if e.get("event") != events.PREDICT_SPAN:
+            continue
+        request_id = e.get("request_id")
+        if not request_id or not isinstance(e.get("ts"), (int, float)):
+            continue
+        span = spans.setdefault(str(request_id), {
+            "request_id": str(request_id),
+            "end_ts": float(e["ts"]),
+            "reason": "sampled",
+            "phases": {},
+        })
+        span["end_ts"] = max(span["end_ts"], float(e["ts"]))
+        reason = e.get("reason")
+        # the router's outcome (error/shed/failover) outranks the
+        # servicer half's default "sampled"
+        if reason and reason != "sampled":
+            span["reason"] = str(reason)
+        phases = e.get("phases_s")
+        if isinstance(phases, dict):
+            for phase, seconds in phases.items():
+                span["phases"][phase] = max(
+                    span["phases"].get(phase, 0.0), float(seconds)
+                )
+        for key in ("code", "model_step", "rows", "error"):
+            if key in e:
+                span.setdefault(key, e[key])
+    return spans
 
 
 def _worker_of(chain: Dict[str, dict]) -> int:
@@ -162,6 +210,48 @@ def build_chrome_trace(evts: List[dict]) -> dict:
                     "dur": _us(by_name[b], t0) - _us(by_name[a], t0),
                     "args": {"task_id": task_id},
                 })
+
+    # Routed serve requests -> nested duration slices on the serving
+    # track, one child slice per recorded phase in causal hop order.
+    # The span event stamps the END of the request; the extent is the
+    # sum of its phase durations laid back-to-back up to that stamp.
+    for request_id, span in sorted(_request_spans(evts).items()):
+        phases = [
+            (phase, span["phases"][phase])
+            for phase in _PHASE_ORDER if phase in span["phases"]
+        ]
+        pid, tid = track("serving", None)
+        total = sum(seconds for _, seconds in phases)
+        end = span["end_ts"]
+        args = {
+            k: span[k]
+            for k in ("request_id", "reason", "code", "model_step",
+                      "rows", "error")
+            if k in span
+        }
+        if total <= 0.0:
+            # no timed extent (e.g. a decode rejection): still visible
+            out.append({
+                "ph": "i", "name": f"request {request_id}",
+                "cat": "request", "s": "t", "pid": pid, "tid": tid,
+                "ts": _us(end, t0), "args": args,
+            })
+            continue
+        out.append({
+            "ph": "X", "name": f"request {request_id}", "cat": "request",
+            "pid": pid, "tid": tid,
+            "ts": _us(end - total, t0), "dur": round(total * 1e6, 3),
+            "args": args,
+        })
+        cursor = end - total
+        for phase, seconds in phases:
+            out.append({
+                "ph": "X", "name": phase, "cat": "request",
+                "pid": pid, "tid": tid,
+                "ts": _us(cursor, t0), "dur": round(seconds * 1e6, 3),
+                "args": {"request_id": request_id},
+            })
+            cursor += seconds
 
     # Point events + recovery outage slices.
     for e in evts:
@@ -260,6 +350,46 @@ def summarize(evts: List[dict], slowest_k: int = 5) -> str:
                 f"  {phase:<10} {phase_totals[phase]:9.3f}s total  "
                 f"{mean * 1e3:8.2f} ms/step  "
                 f"{100.0 * phase_totals[phase] / total:5.1f}%"
+            )
+
+    # Serve-path request spans (predict_span events), per-phase.
+    spans = _request_spans(evts)
+    if spans:
+        outcomes = sorted(
+            s["request_id"] for s in spans.values()
+            if s["reason"] != "sampled"
+        )
+        lines.append("")
+        lines.append(
+            f"serve requests traced: {len(spans)} "
+            f"({len(outcomes)} forensic: error/shed/failover)"
+        )
+        by_phase: Dict[str, List[float]] = {}
+        for span in spans.values():
+            for phase, seconds in span["phases"].items():
+                by_phase.setdefault(phase, []).append(seconds)
+        if by_phase:
+            lines.append(
+                "phase".ljust(12) + "n".rjust(6) + "p50_ms".rjust(10)
+                + "p99_ms".rjust(10) + "mean_ms".rjust(10)
+            )
+            for phase in _PHASE_ORDER:
+                if phase not in by_phase:
+                    continue
+                vals = sorted(by_phase[phase])
+                lines.append(
+                    phase.ljust(12)
+                    + str(len(vals)).rjust(6)
+                    + f"{_quantile(vals, 0.50) * 1e3:.3f}".rjust(10)
+                    + f"{_quantile(vals, 0.99) * 1e3:.3f}".rjust(10)
+                    + f"{sum(vals) / len(vals) * 1e3:.3f}".rjust(10)
+                )
+        for request_id in outcomes[:5]:
+            span = spans[request_id]
+            lines.append(
+                f"  {request_id}: {span['reason']}"
+                + (f" code={span['code']}" if "code" in span else "")
+                + (f" error={span['error']}" if "error" in span else "")
             )
 
     stragglers = [

@@ -281,6 +281,35 @@ def add_common_params(parser: argparse.ArgumentParser):
         "responses must be served from a checkpoint no older than this "
         "many seconds behind the latest produced one.",
     )
+    # ---- request tracing + incident flight recorder (common/flight.py,
+    #      docs/OBSERVABILITY.md "Request tracing & incident bundles") --
+    parser.add_argument(
+        "--trace_sample_rate", type=float, default=1.0,
+        help="Fraction of routed Predict requests whose predict_span "
+        "is recorded end to end (deterministic every-k'th sampling, "
+        "k = round(1/rate); 0 disables).  Error, shed, and failover "
+        "outcomes always capture regardless of the rate.",
+    )
+    parser.add_argument(
+        "--incident_dir", default="",
+        help="Directory the incident flight recorder writes bundles "
+        "into on an slo_breach, policy eviction, or terminal reload "
+        "refusal (one JSON dir per incident: recent request spans, "
+        "decisions, metric-history windows, Master.snapshot(), fault "
+        "stats).  Empty disables capture; the forensic rings still "
+        "fill.  Render with `elasticdl incident`.",
+    )
+    parser.add_argument(
+        "--incident_ring", type=pos_int, default=256,
+        help="Recent predict_span and decision events retained in the "
+        "flight recorder's in-memory rings (each; oldest evicted "
+        "first).",
+    )
+    parser.add_argument(
+        "--incident_max_bundles", type=pos_int, default=8,
+        help="Bundles kept under --incident_dir before the oldest is "
+        "rotated out — soak runs cannot fill the disk.",
+    )
 
 
 def add_model_params(parser: argparse.ArgumentParser):
@@ -487,6 +516,25 @@ def add_trace_params(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--slowest", type=non_neg_int, default=5,
         help="how many slowest tasks the summary lists",
+    )
+
+
+def add_incident_params(parser: argparse.ArgumentParser):
+    """`elasticdl incident`: postmortem reports from flight-recorder
+    bundles (client/incident.py)."""
+    parser.add_argument(
+        "incident_dir",
+        help="directory the master's --incident_dir flight recorder "
+        "wrote bundles into",
+    )
+    parser.add_argument(
+        "--bundle", default="",
+        help="bundle name (or unambiguous prefix) to render a full "
+        "postmortem report for; omitted = list all bundles",
+    )
+    parser.add_argument(
+        "--spans", type=non_neg_int, default=10,
+        help="how many of the slowest request spans the report lists",
     )
 
 

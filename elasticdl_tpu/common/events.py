@@ -48,6 +48,8 @@ FLEET_RELOAD_STEP = "fleet_reload_step"        # one replica hot-swapped
 FLEET_RELOAD_REFUSED = "fleet_reload_refused"  # skew SLO blocked a reload
 SLO_BREACH = "slo_breach"          # burn rate crossed an alert threshold
 SLO_RECOVERED = "slo_recovered"    # burn rate back inside the budget
+PREDICT_SPAN = "predict_span"      # one routed serve request, all phases
+INCIDENT_CAPTURED = "incident_captured"  # flight recorder wrote a bundle
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -58,7 +60,8 @@ VOCABULARY = frozenset({
     CHECKPOINT_SAVED, CHECKPOINT_RESTORED, SERVING_RELOADED,
     RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
     POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
-    FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED,
+    FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED, PREDICT_SPAN,
+    INCIDENT_CAPTURED,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every
@@ -69,12 +72,55 @@ VOCABULARY = frozenset({
 POLICY_ACTIONS = frozenset({"evict", "scale_up", "scale_down"})
 POLICY_REASONS = frozenset({"straggler", "backlog", "data_wait"})
 
+#: Closed vocabularies for the serve-path PREDICT_SPAN event
+#: (docs/OBSERVABILITY.md "Request tracing & incident bundles").
+#: `phase` names one timed hop inside a request; the span's
+#: `phases_s` dict may only carry these keys, and the
+#: `serving_request_phase_seconds{phase=...}` histogram label draws
+#: from the same set.  `reason` is the routing outcome stamped on the
+#: span: "sampled" for the normal sampled-in path, the rest are the
+#: always-captured error/shed/failover outcomes that bypass
+#: `--trace_sample_rate`.
+SPAN_PHASES = frozenset({
+    "route", "queue_wait", "batch_form", "pad", "compute",
+    "unpack", "respond",
+})
+SPAN_REASONS = frozenset({
+    "sampled", "error", "shed", "failover", "invalid", "internal",
+})
+
+#: Triggers the incident flight recorder (common/flight.py) captures
+#: on; the `reason` field of every INCIDENT_CAPTURED event and bundle
+#: manifest draws from this set.
+INCIDENT_TRIGGERS = frozenset({
+    "slo_breach", "policy_eviction", "reload_refused", "manual",
+    "tier1_failure",
+})
+
 _lock = threading.Lock()
 _fh = None
 _path: Optional[str] = None
 _role = ""
 _worker_id: Optional[int] = None
 _max_bytes: Optional[int] = None
+# In-process taps (common/flight.py's incident ring): each observer is
+# called with every emitted record, whether or not a log file is
+# configured.  Observers must be cheap and must never raise.
+_observers: List = []
+
+
+def add_observer(fn) -> None:
+    """Register an in-process tap on the event stream.  `fn(record)` is
+    called for every emit, including when no log file is configured."""
+    with _lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    with _lock:
+        if fn in _observers:
+            _observers.remove(fn)
 
 
 def rotated_path(path: str) -> str:
@@ -147,10 +193,12 @@ def enabled() -> bool:
 
 
 def emit(event: str, **fields) -> None:
-    """Append one span event.  No-op unless configured; never raises —
-    tracing must not be able to fail the training loop."""
+    """Append one span event and feed any in-process observers.  No-op
+    unless configured or observed; never raises — tracing must not be
+    able to fail the training loop."""
     fh = _fh
-    if fh is None:
+    observers = _observers
+    if fh is None and not observers:
         return
     record = {
         "ts": time.time(),
@@ -161,6 +209,13 @@ def emit(event: str, **fields) -> None:
     if _worker_id is not None and "worker_id" not in fields:
         record["worker_id"] = _worker_id
     record.update(fields)
+    for observer in list(observers):
+        try:
+            observer(record)
+        except Exception:
+            pass
+    if fh is None:
+        return
     try:
         line = json.dumps(record, sort_keys=True, default=str)
         with _lock:

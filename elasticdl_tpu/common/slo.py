@@ -130,8 +130,14 @@ class SloEvaluator:
         specs: Optional[Sequence[SloSpec]] = None,
         interval_s: float = 0.0,
         clock: Callable[[], float] = time.time,
+        on_breach: Optional[Callable[[dict], None]] = None,
     ):
         self.history = history
+        # Incident hook (common/flight.py): called once per new breach
+        # decision, OUTSIDE the evaluator lock — the flight recorder's
+        # capture walks Master.snapshot(), which re-enters this
+        # evaluator's snapshot() and would deadlock under the lock.
+        self._on_breach = on_breach
         self.specs = list(specs if specs is not None else shipped_specs())
         self.interval_s = float(interval_s)
         self._clock = clock
@@ -184,12 +190,23 @@ class SloEvaluator:
 
     def tick(self) -> None:
         with self._lock:
-            self._tick_locked()
+            breaches = self._tick_locked()
+        if self._on_breach is not None:
+            for decision in breaches:
+                try:
+                    self._on_breach(dict(decision))
+                except Exception:
+                    logger.exception("slo on_breach hook failed")
 
-    def _tick_locked(self) -> None:
+    def _tick_locked(self) -> List[dict]:
         self.ticks += 1
+        breaches: List[dict] = []
         for spec in self.specs:
-            self._evaluate_locked(spec)
+            decision = self._evaluate_locked(spec)
+            if decision is not None \
+                    and decision.get("event") == events.SLO_BREACH:
+                breaches.append(decision)
+        return breaches
 
     def _bad_ratio(self, spec: SloSpec,
                    window_s: float) -> Optional[float]:
@@ -214,7 +231,7 @@ class SloEvaluator:
         bad = self.history.counter_delta(spec.series, window_s)
         return min(1.0, bad / total)
 
-    def _evaluate_locked(self, spec: SloSpec) -> None:
+    def _evaluate_locked(self, spec: SloSpec) -> Optional[dict]:
         budget = max(1e-9, 1.0 - spec.target)
         fast_ratio = self._bad_ratio(spec, spec.fast_window_s)
         slow_ratio = self._bad_ratio(spec, spec.slow_window_s)
@@ -245,13 +262,14 @@ class SloEvaluator:
         }
         self._last[spec.name] = evidence
         if state == prev:
-            return
+            return None
         self._state[spec.name] = state
         self._set_status_locked(spec.name, state)
         if state == STATE_BREACH:
-            self._record_locked(events.SLO_BREACH, evidence)
-        elif prev == STATE_BREACH:
-            self._record_locked(events.SLO_RECOVERED, evidence)
+            return self._record_locked(events.SLO_BREACH, evidence)
+        if prev == STATE_BREACH:
+            return self._record_locked(events.SLO_RECOVERED, evidence)
+        return None
 
     def _set_status_locked(self, slo: str, state: str) -> None:
         assert state in STATES, state
@@ -260,7 +278,7 @@ class SloEvaluator:
                 1.0 if candidate == state else 0.0
             )
 
-    def _record_locked(self, event: str, evidence: dict) -> None:
+    def _record_locked(self, event: str, evidence: dict) -> dict:
         assert event in events.VOCABULARY, event
         decision = dict(evidence)
         decision["event"] = event
@@ -268,6 +286,7 @@ class SloEvaluator:
         self.decisions.append(decision)
         events.emit(event, **evidence)
         logger.info("slo %s: %s", evidence["slo"], event)
+        return decision
 
     # ---- reads ----------------------------------------------------------
 

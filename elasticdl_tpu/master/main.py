@@ -147,6 +147,7 @@ class Master:
         self.freshness = None
         self.metric_history = None
         self.slo_evaluator = None
+        self.flight_recorder = None
         self._k8s = k8s_client
         if k8s_client is not None:
             from elasticdl_tpu.master.pod_manager import PodManager
@@ -241,7 +242,9 @@ class Master:
         # threads parked exactly like the policy engine.
         history_interval = float(getattr(args, "history_interval", 0.0))
         slo_interval = float(getattr(args, "slo_interval", 0.0))
-        if history_interval > 0 or slo_interval > 0:
+        incident_dir = getattr(args, "incident_dir", "")
+        if history_interval > 0 or slo_interval > 0 or incident_dir:
+            from elasticdl_tpu.common.flight import FlightRecorder
             from elasticdl_tpu.common.history import MetricHistory
             from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
 
@@ -250,10 +253,24 @@ class Master:
                 capacity=int(getattr(args, "history_capacity", 512)),
                 interval_s=history_interval,
             )
+            # Incident flight recorder (docs/OBSERVABILITY.md "Request
+            # tracing & incident bundles"): taps the span-event stream
+            # for its forensic rings; without --incident_dir the rings
+            # still fill but captures are skipped.
+            self.flight_recorder = FlightRecorder(
+                incident_dir=incident_dir or None,
+                ring_capacity=int(getattr(args, "incident_ring", 256)),
+                max_bundles=int(
+                    getattr(args, "incident_max_bundles", 8)
+                ),
+                snapshot_fn=self.snapshot,
+                history=self.metric_history,
+            ).install()
             self.slo_evaluator = SloEvaluator(
                 self.metric_history,
                 specs=shipped_specs(args),
                 interval_s=slo_interval,
+                on_breach=self.flight_recorder.breach,
             )
         self._grpc_server = None
         self._done = threading.Event()
@@ -508,6 +525,8 @@ class Master:
             out["workers"].setdefault(wid, {}).update(stats)
         out["resilience"] = resilience.stats()
         out["faults"] = faults.stats()
+        if self.flight_recorder is not None:
+            out["flight"] = self.flight_recorder.snapshot()
         return out
 
     def telemetry_registries(self) -> list:
@@ -564,6 +583,11 @@ class Master:
             return None
 
     def stop(self):
+        if self.flight_recorder is not None:
+            # write any tap-queued captures while components can still
+            # contribute a coherent Master.snapshot(), then untap
+            self.flight_recorder.flush()
+            self.flight_recorder.close()
         if self.slo_evaluator is not None:
             self.slo_evaluator.stop()
         if self.metric_history is not None:

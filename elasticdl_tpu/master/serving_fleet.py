@@ -109,6 +109,10 @@ class _Replica:
         self.fill_ratio = 0.0
         self.queue_depth = 0
         self.shed = 0
+        # serve-path phase tails (batcher histogram p99s riding the
+        # health RPC's scalar-metric list) — `elasticdl top` columns
+        self.queue_wait_p99_s = 0.0
+        self.compute_p99_s = 0.0
 
 
 class ServingFleetManager:
@@ -421,6 +425,12 @@ class ServingFleetManager:
         health_metrics = {m.name: m.value for m in response.metrics}
         rep.fill_ratio = float(health_metrics.get("batch_fill_ratio", 0.0))
         rep.shed = int(health_metrics.get("shed", 0))
+        rep.queue_wait_p99_s = float(
+            health_metrics.get("phase_queue_wait_p99_s", 0.0)
+        )
+        rep.compute_p99_s = float(
+            health_metrics.get("phase_compute_p99_s", 0.0)
+        )
         produced = health_metrics.get("produced_unix_s")
         if self._router is not None:
             self._router.mark_live(rep.replica_id)
@@ -546,6 +556,10 @@ class ServingFleetManager:
                         "fill_ratio": round(rep.fill_ratio, 3),
                         "queue_depth": rep.queue_depth,
                         "shed": rep.shed,
+                        "queue_wait_p99_s": round(
+                            rep.queue_wait_p99_s, 6
+                        ),
+                        "compute_p99_s": round(rep.compute_p99_s, 6),
                         "probe_failures": rep.probe_failures,
                         "incarnation": rep.incarnation,
                     }

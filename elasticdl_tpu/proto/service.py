@@ -15,7 +15,9 @@ traffic lives on the device mesh in the TPU-native design.
 from __future__ import annotations
 
 import threading
+import time
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common import metrics as _metrics
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -273,6 +275,11 @@ _fleet_request_errors_counter = _metrics.default_registry().counter(
     "Predict requests that failed after every replica and retry was "
     "exhausted — the bad events of the predict_availability SLO",
 )
+_fleet_route_histogram = _metrics.default_registry().histogram(
+    "rpc_fleet_route_seconds",
+    "router-side end-to-end Predict latency (the `route` phase of the "
+    "request span: sweeps + backoff until a response or exhaustion)",
+)
 
 #: In-band codes the router treats as routing signals: the replica is up
 #: but refusing load, so re-offer elsewhere — never re-offer through the
@@ -309,7 +316,8 @@ class FleetRouter:
       load and a loaded replica drains before it sheds.
     """
 
-    def __init__(self, clients=None, retry_policy=None, freshness=None):
+    def __init__(self, clients=None, retry_policy=None, freshness=None,
+                 trace_sample_rate: float = 1.0, clock=time.monotonic):
         if retry_policy is None:
             from elasticdl_tpu.common.resilience import default_policy
 
@@ -330,6 +338,16 @@ class FleetRouter:
         self._max_skew = 0
         self._failovers = {"error": 0, "overloaded": 0, "shutdown": 0}
         self._last_staleness = (0, 0.0)
+        # Trace context (docs/OBSERVABILITY.md "Request tracing"): ids
+        # come off a monotonic per-router counter — deterministic under
+        # the fault harness, unlike uuid/wall-clock — and sampling is the
+        # deterministic every-k'th request for the same reason.  k=0
+        # (rate<=0) disables sampling; errors/sheds/failovers are
+        # captured regardless (the always-on forensic path).
+        rate = max(0.0, min(1.0, float(trace_sample_rate)))
+        self._trace_every = int(round(1.0 / rate)) if rate > 0 else 0
+        self._seq = 0
+        self._clock = clock
 
     # ---- fleet membership (driven by the ServingFleetManager) ---------
 
@@ -489,13 +507,74 @@ class FleetRouter:
     def predict(self, request, timeout=None):
         """Route one Predict through the resilience policy: each attempt
         is a full fleet sweep, so backoff only happens when no replica
-        could take the request at all."""
+        could take the request at all.
+
+        Every request gets a deterministic `request_id`; sampled-in
+        requests carry it on the wire (the replica stamps its span
+        against it), and the router emits its own span — always for
+        errors/sheds/failovers, per `trace_sample_rate` otherwise."""
         _fleet_requests_counter.inc()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            failovers_before = sum(self._failovers.values())
+        sampled = self._trace_every > 0 and seq % self._trace_every == 0
+        request_id = f"rq-{seq:08d}"
+        if hasattr(request, "request_id"):
+            # always (re)stamp: a caller-reused request proto must not
+            # ride the wire with the previous call's trace context
+            request.request_id = request_id if sampled else ""
+        route_start = self._clock()
         try:
-            return self._retry_policy.call(
+            response = self._retry_policy.call(
                 lambda: self._sweep(request, timeout=timeout),
                 description="fleet_predict",
             )
-        except Exception:
+        except Exception as exc:
             _fleet_request_errors_counter.inc()
+            route_s = max(0.0, self._clock() - route_start)
+            _fleet_route_histogram.record(route_s)
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="error", error=type(exc).__name__,
+                phases_s={"route": route_s},
+            )
             raise
+        route_s = max(0.0, self._clock() - route_start)
+        _fleet_route_histogram.record(route_s)
+        if hasattr(response, "request_id") and not response.request_id:
+            response.request_id = request_id
+        with self._lock:
+            failed_over = sum(self._failovers.values()) > failovers_before
+        phases = {"route": route_s}
+        if response.code in SHED_CODES:
+            # whole-fleet shed: admission control spoke — always capture
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="shed", code=int(response.code), phases_s=phases,
+            )
+        elif response.code == spb.SERVING_INVALID:
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="invalid", code=int(response.code), phases_s=phases,
+            )
+        elif response.code == spb.SERVING_INTERNAL:
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="internal", code=int(response.code),
+                phases_s=phases,
+            )
+        elif failed_over:
+            # served OK but not by the first choice: capture the hop
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="failover", code=int(response.code),
+                phases_s=phases,
+            )
+        elif sampled:
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="sampled", code=int(response.code),
+                phases_s=phases,
+            )
+        return response

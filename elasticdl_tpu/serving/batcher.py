@@ -26,6 +26,7 @@ SHUTTING_DOWN.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
@@ -58,6 +59,22 @@ class ServingResult:
     error: str = ""
     predictions: Optional[np.ndarray] = None
     model_step: int = 0
+    # Trace context (docs/OBSERVABILITY.md "Request tracing"): the
+    # request_id echoed from submit(), and per-phase durations
+    # (queue_wait/batch_form/pad/compute/unpack) the span exporter and
+    # the `serving_request_phase_seconds{phase}` histogram both read.
+    request_id: str = ""
+    phases_s: Optional[Dict[str, float]] = None
+
+
+def _merge_phases(results) -> Optional[Dict[str, float]]:
+    """Worst-case per-phase durations across split-request chunks — the
+    chunk that waited longest is the one the caller experienced."""
+    merged: Dict[str, float] = {}
+    for r in results:
+        for phase, seconds in (r.phases_s or {}).items():
+            merged[phase] = max(merged.get(phase, 0.0), seconds)
+    return merged or None
 
 
 @dataclass
@@ -66,6 +83,7 @@ class _Item:
     rows: int
     future: Future
     enqueued_at: float
+    request_id: str = ""
     # for split oversized requests: (aggregate, chunk_index)
     aggregate: Optional["_Aggregate"] = None
     chunk_index: int = 0
@@ -97,6 +115,8 @@ class _Aggregate:
                 [r.predictions for _, r in chunks], axis=0
             ),
             model_step=min(r.model_step for _, r in chunks),
+            request_id=chunks[0][1].request_id,
+            phases_s=_merge_phases(r for _, r in chunks),
         ))
 
 
@@ -140,6 +160,12 @@ class BatcherMetrics:
             "serving_batch_latency_seconds",
             "enqueue-to-completion latency per request row group",
         )
+        self.phase = self.registry.histogram(
+            "serving_request_phase_seconds",
+            "per-request serve-path phase latency "
+            "(queue_wait/batch_form/pad/compute/unpack/respond)",
+            labelnames=("phase",),
+        )
         self.registry.gauge_fn(
             "serving_batch_fill_ratio",
             self._mean_fill,
@@ -164,9 +190,19 @@ class BatcherMetrics:
     def record_internal(self) -> None:
         self._rejected.labels(reason="internal").inc()
 
+    def record_phase(self, phase: str, seconds: float) -> None:
+        self.phase.labels(phase=phase).record(max(0.0, seconds))
+
     def snapshot(self) -> dict:
         lat = self.latency.snapshot()
+        queue_wait = self.phase.labels(phase="queue_wait").snapshot()
+        compute = self.phase.labels(phase="compute").snapshot()
         return {
+            # per-phase serve latency (docs/OBSERVABILITY.md "Request
+            # tracing"): rides Health RPC scalars so `elasticdl top`'s
+            # fleet table can show overload without a trace dump
+            "phase_queue_wait_p99_s": queue_wait["p99_s"],
+            "phase_compute_p99_s": compute["p99_s"],
             "ok_rows": self._rows.value(),
             "batches": self._batches.value(),
             "batch_fill_ratio": self._mean_fill(),
@@ -205,6 +241,16 @@ class DynamicBatcher:
         )
         self._reject_oversized = reject_oversized
         self._clock = clock
+        # engines predating the tracing contract (or test fakes) may not
+        # accept phase_out=; probe once and skip phase capture for them
+        try:
+            params = inspect.signature(engine.predict).parameters
+            self._engine_traces = "phase_out" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):
+            self._engine_traces = False
         self.metrics = BatcherMetrics()
         self.metrics.registry.gauge_fn(
             "serving_queue_depth_rows",
@@ -228,9 +274,12 @@ class DynamicBatcher:
         with self._cond:
             return self._queued_rows
 
-    def submit(self, features: Dict[str, np.ndarray]) -> Future:
+    def submit(self, features: Dict[str, np.ndarray],
+               request_id: str = "") -> Future:
         """Returns a Future resolving to ServingResult.  Never raises and
-        never blocks: invalid/overload/shutdown resolve immediately."""
+        never blocks: invalid/overload/shutdown resolve immediately.
+        `request_id` is the router-minted trace context; it is echoed on
+        the result and stamped into the per-request span."""
         error = self._engine.validate(features)
         if error is not None:
             self.metrics.record_invalid()
@@ -245,10 +294,11 @@ class DynamicBatcher:
                     f"{self._max_batch} "
                     "(oversized requests are rejected by policy)",
                 )
-            return self._submit_split(features, rows)
-        return self._enqueue(features, rows)
+            return self._submit_split(features, rows, request_id)
+        return self._enqueue(features, rows, request_id)
 
-    def _submit_split(self, features, rows: int) -> Future:
+    def _submit_split(self, features, rows: int,
+                      request_id: str = "") -> Future:
         chunk = self._max_batch
         n_chunks = (rows + chunk - 1) // chunk
         agg = _Aggregate(future=Future(), pending=n_chunks)
@@ -269,14 +319,15 @@ class DynamicBatcher:
                 part = {k: v[lo:hi] for k, v in features.items()}
                 item = _Item(
                     features=part, rows=hi - lo, future=Future(),
-                    enqueued_at=now, aggregate=agg, chunk_index=i,
+                    enqueued_at=now, request_id=request_id,
+                    aggregate=agg, chunk_index=i,
                 )
                 self._queue.append(item)
                 self._queued_rows += item.rows
             self._cond.notify()
         return agg.future
 
-    def _enqueue(self, features, rows: int) -> Future:
+    def _enqueue(self, features, rows: int, request_id: str = "") -> Future:
         with self._cond:
             if self._stopped:
                 return _resolved(SHUTTING_DOWN, "server is shutting down")
@@ -288,7 +339,7 @@ class DynamicBatcher:
                 )
             item = _Item(
                 features=features, rows=rows, future=Future(),
-                enqueued_at=self._clock(),
+                enqueued_at=self._clock(), request_id=request_id,
             )
             self._queue.append(item)
             self._queued_rows += rows
@@ -361,22 +412,51 @@ class DynamicBatcher:
 
     def _execute_uniform(self, batch) -> None:
         rows = sum(item.rows for item in batch)
+        # phase clock starts when the batch is cut: queue_wait ends
+        # here, batch_form covers assembly, pad/compute/unpack come
+        # back from the engine (docs/OBSERVABILITY.md "Request tracing")
+        popped_at = self._clock()
+        queue_waits = {
+            id(item): max(0.0, popped_at - item.enqueued_at)
+            for item in batch
+        }
+        for wait in queue_waits.values():
+            self.metrics.record_phase("queue_wait", wait)
         features = {
             k: np.concatenate(
                 [np.asarray(item.features[k]) for item in batch], axis=0
             )
             for k in batch[0].features
         }
+        batch_form_s = max(0.0, self._clock() - popped_at)
+        self.metrics.record_phase("batch_form", batch_form_s)
+        engine_phases: Dict[str, float] = {}
+
+        def item_phases(item):
+            phases = {"queue_wait": queue_waits[id(item)],
+                      "batch_form": batch_form_s}
+            phases.update(engine_phases)
+            return phases
+
         try:
-            preds, step = self._engine.predict(features, rows)
+            if self._engine_traces:
+                preds, step = self._engine.predict(
+                    features, rows, phase_out=engine_phases
+                )
+            else:
+                preds, step = self._engine.predict(features, rows)
         except Exception as exc:  # engine failure: fail THIS batch only
             logger.exception("serving batch execution failed")
             self.metrics.record_internal()
             for item in batch:
                 self._finish(item, ServingResult(
                     code=INTERNAL, error=f"execution failed: {exc}",
+                    request_id=item.request_id,
+                    phases_s=item_phases(item),
                 ))
             return
+        for phase, seconds in engine_phases.items():
+            self.metrics.record_phase(phase, seconds)
         bucket = self._engine.bucket_for(rows)
         self.metrics.record_batch(rows, bucket)
         now = self._clock()
@@ -387,6 +467,8 @@ class DynamicBatcher:
                 code=OK,
                 predictions=preds[offset:offset + item.rows],
                 model_step=step,
+                request_id=item.request_id,
+                phases_s=item_phases(item),
             ))
             offset += item.rows
 

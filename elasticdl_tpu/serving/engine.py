@@ -29,6 +29,7 @@ Design (docs/SERVING.md):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -119,6 +120,9 @@ class ServingEngine:
         self._single = set(self._feature_spec) == {SINGLE_FEATURE_KEY}
         self._has_train = model_has_train_kwarg(model)
         self._lock = threading.Lock()
+        # phase-timing clock (docs/OBSERVABILITY.md "Request tracing");
+        # public so deterministic tests can inject a fake
+        self.clock = time.perf_counter
         # Per-instance registry (common/metrics.py): compile/swap counts
         # live ONLY here; the properties below and the Health RPC read
         # the same series the /metrics exposition renders.
@@ -366,10 +370,15 @@ class ServingEngine:
         )
 
     def predict(
-        self, features: Dict[str, np.ndarray], rows: int
+        self, features: Dict[str, np.ndarray], rows: int,
+        phase_out: Optional[Dict[str, float]] = None,
     ) -> Tuple[np.ndarray, int]:
         """Run the forward pass on `rows` leading rows of `features`,
         padding up to the nearest bucket; returns (predictions, step).
+        When `phase_out` is given it receives the engine-side phase
+        durations {"pad", "compute", "unpack"} in seconds — the batcher
+        folds them into per-request spans and the
+        `serving_request_phase_seconds{phase}` histogram.
 
         Oversized batches are the batcher's job to split; this raises."""
         bucket = self.bucket_for(rows)
@@ -378,6 +387,7 @@ class ServingEngine:
                 f"batch of {rows} rows exceeds largest bucket "
                 f"{self.max_bucket}"
             )
+        t0 = self.clock()
         padded = {}
         for name, arr in features.items():
             arr = np.asarray(arr)
@@ -389,8 +399,17 @@ class ServingEngine:
             padded[name] = arr
         with self._lock:
             variables, step = self._variables, self._step
+        t1 = self.clock()
         out = run_device_serialized(self._forward, variables, padded)
-        return np.asarray(out)[:rows], step
+        t2 = self.clock()
+        # host transfer + row slice: the dequant/unpack leg of the span
+        result = np.asarray(out)[:rows]
+        if phase_out is not None:
+            t3 = self.clock()
+            phase_out["pad"] = max(0.0, t1 - t0)
+            phase_out["compute"] = max(0.0, t2 - t1)
+            phase_out["unpack"] = max(0.0, t3 - t2)
+        return result, step
 
     # ---- hot reload -----------------------------------------------------
 

@@ -11,10 +11,12 @@ serving.proto).
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common import telemetry as telemetry_lib
 from elasticdl_tpu.common.export import SINGLE_FEATURE_KEY
@@ -103,22 +105,46 @@ class ServingServicer:
         self._request_timeout_s = request_timeout_s
 
     def predict(self, request, context) -> spb.PredictResponse:
+        # Trace context: a non-empty request_id means the router sampled
+        # this request in; it rides the batcher, stamps the span, and is
+        # echoed on the response for client-side correlation.
+        request_id = getattr(request, "request_id", "")
         try:
             features = decode_features(request)
         except ValueError as exc:
+            if request_id:
+                events.emit(
+                    events.PREDICT_SPAN, request_id=request_id,
+                    reason="invalid", code=int(spb.SERVING_INVALID),
+                )
             return spb.PredictResponse(
-                code=spb.SERVING_INVALID, error=str(exc)
+                code=spb.SERVING_INVALID, error=str(exc),
+                request_id=request_id,
             )
-        result = self._batcher.submit(features).result(
-            timeout=self._request_timeout_s
-        )
+        rows = int(next(iter(features.values())).shape[0])
+        result = self._batcher.submit(
+            features, request_id=request_id
+        ).result(timeout=self._request_timeout_s)
+        clock = getattr(self._engine, "clock", None) or time.perf_counter
+        encode_start = clock()
         response = spb.PredictResponse(
             code=result.code, error=result.error,
-            model_step=result.model_step,
+            model_step=result.model_step, request_id=request_id,
         )
         if result.predictions is not None:
             response.predictions.CopyFrom(
                 to_tensor_proto(result.predictions)
+            )
+        respond_s = max(0.0, clock() - encode_start)
+        self._batcher.metrics.record_phase("respond", respond_s)
+        if request_id:
+            phases = dict(result.phases_s or {})
+            phases["respond"] = respond_s
+            events.emit(
+                events.PREDICT_SPAN, request_id=request_id,
+                reason="sampled", code=int(result.code),
+                model_step=int(result.model_step), rows=rows,
+                phases_s=phases,
             )
         return response
 

@@ -23,6 +23,7 @@ from scripts.graftlint.rules_metrics import (  # noqa: E402,F401
     find_shadow_counters,
     find_stringly_events,
     find_unlabeled_policy_decisions,
+    find_untraced_predict_spans,
     literal_metric_name,
 )
 

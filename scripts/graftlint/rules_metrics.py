@@ -29,6 +29,14 @@ patterns over elasticdl_tpu/:
 4. **Policy-decision fields.**  Every `emit(events.POLICY_DECISION,
    ...)` must carry `action=`/`reason=` string literals drawn from the
    closed POLICY_ACTIONS / POLICY_REASONS vocabularies.
+
+5. **Request-span fields.**  Every `emit(events.PREDICT_SPAN, ...)`
+   must carry a `request_id=` kwarg (a span an operator cannot
+   correlate by request id is forensic noise), its `reason=` must be a
+   string literal from SPAN_REASONS, and a `phase=` kwarg, if present,
+   must be a string literal from SPAN_PHASES — the same closed sets
+   the `serving_request_phase_seconds{phase}` histogram and
+   docs/OBSERVABILITY.md draw from.
 """
 
 from __future__ import annotations
@@ -52,6 +60,8 @@ if REPO not in sys.path:  # the shared validators live in the runtime
 from elasticdl_tpu.common.events import (  # noqa: E402
     POLICY_ACTIONS,
     POLICY_REASONS,
+    SPAN_PHASES,
+    SPAN_REASONS,
 )
 from elasticdl_tpu.common.metrics import validate_metric_name  # noqa: E402
 
@@ -197,6 +207,60 @@ def find_unlabeled_policy_decisions(tree: ast.AST):
                 )
 
 
+def find_untraced_predict_spans(tree: ast.AST):
+    """Yield (lineno, message) for `emit(events.PREDICT_SPAN, ...)`
+    calls missing `request_id=`, or whose `reason=`/`phase=` fields are
+    computed or outside the closed SPAN_REASONS / SPAN_PHASES
+    vocabularies in common/events.py."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Attribute)
+                and first.attr == "PREDICT_SPAN"):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if "request_id" not in kwargs:
+            yield (
+                node.lineno,
+                "emit(events.PREDICT_SPAN, ...) must carry "
+                "request_id= — a span an operator cannot correlate by "
+                "request id is forensic noise",
+            )
+        for field, vocab, required in (
+            ("reason", SPAN_REASONS, True),
+            ("phase", SPAN_PHASES, False),
+        ):
+            value = kwargs.get(field)
+            if value is None:
+                if required:
+                    yield (
+                        node.lineno,
+                        "emit(events.PREDICT_SPAN, ...) must carry "
+                        f"{field}= so always-capture outcomes "
+                        "(error/shed/failover) are greppable off the "
+                        "event stream",
+                    )
+            elif not (isinstance(value, ast.Constant)
+                      and isinstance(value.value, str)):
+                yield (
+                    node.lineno,
+                    f"emit(events.PREDICT_SPAN, ...): {field}= must be "
+                    "a string literal from the closed vocabulary in "
+                    "common/events.py, not a computed value",
+                )
+            elif value.value not in vocab:
+                yield (
+                    node.lineno,
+                    f"emit(events.PREDICT_SPAN, ...): "
+                    f"{field}={value.value!r} is not in the closed "
+                    f"vocabulary {sorted(vocab)}",
+                )
+
+
 def find_shadow_counters(tree: ast.AST):
     """Yield (lineno, message, attr_or_None) for private tallies:
     `self.x = 0` counter-shaped attrs and collections.Counter
@@ -262,6 +326,8 @@ class MetricRule(Rule):
             for lineno, message in find_stringly_events(pf.tree):
                 yield Finding(pf.rel, lineno, self.id, message)
         for lineno, message in find_unlabeled_policy_decisions(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+        for lineno, message in find_untraced_predict_spans(pf.tree):
             yield Finding(pf.rel, lineno, self.id, message)
         if pf.rel in INSTRUMENTED:
             for lineno, message, attr in find_shadow_counters(pf.tree):

@@ -42,5 +42,25 @@ if [ "$lint_rc" -ne 0 ] || [ "$make_rc" -ne 0 ] || [ "$pytest_rc" -ne 0 ]; then
   status=fail
   rc=1
 fi
+
+# A red tier-1 run leaves forensics behind: capture an incident bundle
+# (docs/OBSERVABILITY.md "Request tracing & incident bundles") with the
+# exit codes as evidence, into ${TIER1_INCIDENT_DIR:-/tmp/elasticdl-ci-incidents}.
+if [ "$pytest_rc" -ne 0 ]; then
+  TIER1_INCIDENT_DIR="${TIER1_INCIDENT_DIR:-/tmp/elasticdl-ci-incidents}" \
+  PYTEST_RC="$pytest_rc" LINT_RC="$lint_rc" MAKE_RC="$make_rc" \
+  python - <<'EOF' || true
+import os
+from elasticdl_tpu.common.flight import FlightRecorder
+
+recorder = FlightRecorder(incident_dir=os.environ["TIER1_INCIDENT_DIR"])
+path = recorder.capture("tier1_failure", evidence={
+    "pytest_rc": int(os.environ["PYTEST_RC"]),
+    "lint_rc": int(os.environ["LINT_RC"]),
+    "make_rc": int(os.environ["MAKE_RC"]),
+})
+print(f"tier1 incident bundle: {path}")
+EOF
+fi
 echo "TIER1_SUMMARY passed=${passed} wall_s=${wall_s} lint_findings=${lint_findings} status=${status}"
 exit "$rc"

@@ -1,0 +1,308 @@
+"""Flight-recorder acceptance: bounded forensic rings, deduped+re-armed
+trigger captures, self-contained byte-stable bundles with rotation, and
+the `elasticdl incident` read side (docs/OBSERVABILITY.md "Request
+tracing & incident bundles")."""
+
+import json
+import os
+
+import pytest
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common.flight import (
+    FlightRecorder,
+    list_bundles,
+    load_bundle,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    events.configure(None)
+
+
+def _span(rid, reason="sampled", **extra):
+    record = {
+        "ts": 123.4, "pid": 99, "event": events.PREDICT_SPAN,
+        "request_id": rid, "reason": reason,
+        "phases_s": {"queue_wait": 0.001, "compute": 0.004},
+    }
+    record.update(extra)
+    return record
+
+
+def _breach(slo="staleness_p99", **extra):
+    record = {
+        "ts": 123.4, "pid": 99, "event": events.SLO_BREACH,
+        "slo": slo, "fast_burn": 12.0, "slow_burn": 3.0,
+    }
+    record.update(extra)
+    return record
+
+
+# ---- rings ---------------------------------------------------------------
+
+
+def test_rings_are_bounded():
+    recorder = FlightRecorder(ring_capacity=4)
+    for i in range(10):
+        recorder.observe(_span(f"rq-{i:08d}"))
+        recorder.observe({
+            "ts": 1.0, "pid": 9, "event": events.FLEET_RELOAD_STEP,
+            "replica": i, "step": 5,
+        })
+    snap = recorder.snapshot()
+    assert snap["spans_buffered"] == 4
+    assert snap["decisions_buffered"] == 4
+    assert snap["incident_dir"] is None
+    assert snap["captured"] == []
+
+
+def test_install_taps_and_close_untaps():
+    recorder = FlightRecorder().install()
+    try:
+        events.emit(
+            events.PREDICT_SPAN, request_id="rq-00000001",
+            reason="sampled", phases_s={"route": 0.001},
+        )
+        assert recorder.snapshot()["spans_buffered"] == 1
+    finally:
+        recorder.close()
+    events.emit(
+        events.PREDICT_SPAN, request_id="rq-00000002",
+        reason="sampled", phases_s={"route": 0.001},
+    )
+    assert recorder.snapshot()["spans_buffered"] == 1  # tap removed
+
+
+# ---- triggers: dedup, re-arm, immediate breach capture -------------------
+
+
+def test_slo_breach_trigger_dedups_and_rearms_on_recovery(tmp_path):
+    recorder = FlightRecorder(incident_dir=str(tmp_path))
+    recorder.observe(_breach())
+    recorder.observe(_breach())  # same burning SLO: one capture, not two
+    assert recorder.snapshot()["pending"] == 1
+    assert len(recorder.flush()) == 1
+    recorder.observe(_breach())  # still armed-out until recovery
+    assert recorder.flush() == []
+    recorder.observe({
+        "ts": 1.0, "pid": 9, "event": events.SLO_RECOVERED,
+        "slo": "staleness_p99",
+    })
+    recorder.observe(_breach())  # re-armed: the next burn captures again
+    assert len(recorder.flush()) == 1
+    assert recorder.snapshot()["captured"] == [
+        "incident-0001-slo_breach", "incident-0002-slo_breach",
+    ]
+
+
+def test_breach_hook_captures_immediately_and_dedups_the_tap(tmp_path):
+    recorder = FlightRecorder(incident_dir=str(tmp_path))
+    # the tap sees the breach event first (the evaluator emits before
+    # invoking on_breach); the hook must not double-capture it
+    recorder.observe(_breach())
+    paths = recorder.breach({"slo": "staleness_p99", "fast_burn": 12.0})
+    assert len(paths) == 1
+    assert os.path.isdir(paths[0])
+    manifest = load_bundle(paths[0])["manifest"]
+    assert manifest["trigger"] == "slo_breach"
+    assert manifest["evidence"]["fast_burn"] == 12.0
+
+
+def test_policy_eviction_and_reload_refusal_trigger(tmp_path):
+    recorder = FlightRecorder(incident_dir=str(tmp_path))
+    recorder.observe({
+        "ts": 1.0, "pid": 9, "event": events.POLICY_DECISION,
+        "action": "evict", "reason": "straggler", "worker_id": 3,
+    })
+    recorder.observe({  # non-eviction decisions ring but never trigger
+        "ts": 1.0, "pid": 9, "event": events.POLICY_DECISION,
+        "action": "scale_up", "reason": "backlog",
+    })
+    recorder.observe({
+        "ts": 1.0, "pid": 9, "event": events.FLEET_RELOAD_REFUSED,
+        "target_step": 50, "projected_skew": 45, "slo": 10,
+    })
+    paths = recorder.flush()
+    triggers = [load_bundle(p)["manifest"]["trigger"] for p in paths]
+    assert triggers == ["policy_eviction", "reload_refused"]
+    assert recorder.snapshot()["decisions_buffered"] == 3
+
+
+# ---- bundle contents -----------------------------------------------------
+
+
+class _History:
+    def snapshot(self):
+        return {"interval_s": 1.0, "series": {"m": [1.0, 2.0]}}
+
+
+def test_capture_writes_self_contained_bundle(tmp_path):
+    captured_events = []
+    events.add_observer(captured_events.append)
+    recorder = FlightRecorder(
+        incident_dir=str(tmp_path),
+        snapshot_fn=lambda: {"slo": {"slos": []}, "ts": 5.0},
+        history=_History(),
+    )
+    try:
+        recorder.observe(_span("rq-00000001"))
+        recorder.observe(_span("rq-00000002", reason="shed"))
+        recorder.observe(_breach())
+        path = recorder.capture(
+            "manual", evidence={"note": "operator", "ts": 9.9}
+        )
+    finally:
+        events.remove_observer(captured_events.append)
+    assert path is not None
+    bundle = load_bundle(path)
+    manifest = bundle["manifest"]
+    assert manifest["format"] == 1
+    assert manifest["bundle"] == "incident-0001-manual"
+    assert manifest["counts"] == {"spans": 2, "decisions": 1}
+    assert sorted(manifest["files"]) == [
+        "decisions.json", "faults.json", "history.json",
+        "master.json", "spans.json",
+    ]
+    # run-variant fields are stripped everywhere a bundle persists
+    assert manifest["evidence"] == {"note": "operator"}
+    assert all("ts" not in s and "pid" not in s for s in bundle["spans"])
+    assert "ts" not in bundle["master"]
+    assert [s["request_id"] for s in bundle["spans"]] == [
+        "rq-00000001", "rq-00000002",
+    ]
+    assert bundle["decisions"][0]["event"] == "slo_breach"
+    assert bundle["history"]["series"] == {"m": [1.0, 2.0]}
+    # the capture itself lands on the event stream
+    assert [e["event"] for e in captured_events] == ["incident_captured"]
+    assert captured_events[0]["bundle"] == "incident-0001-manual"
+
+
+def test_capture_without_incident_dir_is_a_noop():
+    recorder = FlightRecorder()
+    recorder.observe(_span("rq-00000001"))
+    assert recorder.capture("manual") is None
+    assert recorder.snapshot()["captured"] == []
+
+
+def test_rotation_keeps_only_newest_bundles(tmp_path):
+    recorder = FlightRecorder(incident_dir=str(tmp_path), max_bundles=2)
+    for _ in range(4):
+        assert recorder.capture("manual") is not None
+    on_disk = sorted(os.listdir(str(tmp_path)))
+    assert on_disk == ["incident-0003-manual", "incident-0004-manual"]
+    # list_bundles sees exactly what survived rotation, capture order
+    assert [m["bundle"] for m in list_bundles(str(tmp_path))] == on_disk
+
+
+def test_list_bundles_handles_missing_and_junk_dirs(tmp_path):
+    assert list_bundles(str(tmp_path / "nope")) == []
+    (tmp_path / "not-a-bundle").mkdir()
+    recorder = FlightRecorder(incident_dir=str(tmp_path))
+    recorder.capture("manual")
+    assert [m["bundle"] for m in list_bundles(str(tmp_path))] == [
+        "incident-0001-manual"
+    ]
+
+
+def test_bundle_bytes_are_stable_across_identical_runs(tmp_path):
+    def run(subdir):
+        recorder = FlightRecorder(
+            incident_dir=str(tmp_path / subdir),
+            snapshot_fn=lambda: {"slo": {"slos": []}},
+            history=_History(),
+        )
+        recorder.observe(_span("rq-00000001", ts=1.0, pid=1))
+        recorder.observe(_breach(ts=2.0, pid=2))
+        path = recorder.breach({"slo": "staleness_p99"})[0]
+        return {
+            name: open(os.path.join(path, name), "rb").read()
+            for name in sorted(os.listdir(path))
+        }
+
+    assert run("a") == run("b")
+
+
+# ---- the `elasticdl incident` CLI ---------------------------------------
+
+
+def _seed_incident_dir(tmp_path):
+    recorder = FlightRecorder(
+        incident_dir=str(tmp_path),
+        snapshot_fn=lambda: {"slo": {"slos": [{
+            "slo": "staleness_p99", "state": "breach",
+            "fast_burn": 12.5, "slow_burn": 3.0,
+        }]}},
+    )
+    recorder.observe(_span(
+        "rq-00000007",
+        phases_s={"queue_wait": 0.004, "compute": 0.020},
+    ))
+    recorder.observe(_span("rq-00000008", reason="shed", phases_s={}))
+    recorder.observe(_breach())
+    recorder.breach({"slo": "staleness_p99", "fast_burn": 12.5})
+    return recorder
+
+
+def test_incident_cli_lists_and_renders_a_report(tmp_path, capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    _seed_incident_dir(tmp_path)
+    rc = cli_main(["incident", str(tmp_path)])
+    assert rc == 0
+    listing = capsys.readouterr().out
+    assert "incident-0001-slo_breach" in listing
+    assert "slo_breach" in listing
+
+    rc = cli_main(["incident", str(tmp_path), "--bundle", "incident-0001"])
+    assert rc == 0
+    report = capsys.readouterr().out
+    assert "incident incident-0001-slo_breach" in report
+    assert "trigger: slo_breach" in report
+    assert "fast_burn=12.5" in report
+    assert "slo states at capture:" in report
+    assert "staleness_p99" in report and "breach" in report
+    assert "decisions before the incident" in report
+    assert "request spans in the ring: 2 (1 forensic" in report
+    assert "rq-00000007" in report
+    assert "compute=20.00ms" in report
+    assert "rq-00000008 [shed]" in report
+
+
+def test_incident_cli_rejects_bad_bundle_selectors(tmp_path, capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    recorder = _seed_incident_dir(tmp_path)
+    recorder.capture("manual")
+
+    rc = cli_main(["incident", str(tmp_path), "--bundle", "incident-9"])
+    assert rc == 1
+    assert "no bundle matches" in capsys.readouterr().out
+
+    rc = cli_main(["incident", str(tmp_path), "--bundle", "incident-0"])
+    assert rc == 1
+    assert "ambiguous" in capsys.readouterr().out
+
+
+def test_incident_cli_reports_empty_dir(tmp_path, capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    rc = cli_main(["incident", str(tmp_path)])
+    assert rc == 1
+    assert "no bundles" in capsys.readouterr().out
+
+
+def test_incident_report_includes_fault_stats(tmp_path, capsys):
+    from elasticdl_tpu.client.incident import format_report
+
+    bundle = {
+        "manifest": {"bundle": "incident-0001-manual",
+                     "trigger": "manual", "evidence": {}},
+        "faults": {"planned": 6, "injected": 4,
+                   "by_action": {"raise": 4}, "notes": 1},
+    }
+    report = format_report(bundle)
+    assert "fault injections active: 4/6 planned" in report
+    assert "raise=4" in report

@@ -1,0 +1,430 @@
+"""Serve-path request tracing acceptance: the FleetRouter mints a
+deterministic request_id per Predict, every-k'th sampling decides which
+requests carry it on the wire, each hop records its phase into the span
+and the `serving_request_phase_seconds{phase}` histogram, and the
+error/shed/failover outcomes bypass sampling entirely (docs/
+OBSERVABILITY.md "Request tracing & incident bundles")."""
+
+import ast
+import json
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common.resilience import RetryPolicy
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.proto.service import FleetRouter, InProcessServingClient
+from elasticdl_tpu.serving.batcher import DynamicBatcher
+from elasticdl_tpu.serving.server import ServingServicer, make_predict_request
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    events.configure(None)
+
+
+@pytest.fixture
+def records():
+    collected = []
+    events.add_observer(collected.append)
+    yield collected
+    events.remove_observer(collected.append)
+
+
+def _no_sleep_policy(max_attempts=4):
+    return RetryPolicy(
+        initial_backoff_s=0.0, max_backoff_s=0.0, max_elapsed_s=30.0,
+        max_attempts=max_attempts, sleep=lambda _s: None,
+    )
+
+
+class FakeEngine:
+    """Minimal engine honoring the batcher's contract: bucket metadata,
+    validate(), and predict() -> (predictions, step) stamping the
+    engine-side phases into phase_out."""
+
+    def __init__(self, step=7, fail=False):
+        self.max_bucket = 8
+        self.buckets = (8,)
+        self.step = step
+        self.compile_count = 1
+        self.swap_count = 0
+        self.clock = time.perf_counter
+        self.fail = fail
+
+    def validate(self, features):
+        return None
+
+    def bucket_for(self, rows):
+        return 8 if rows <= 8 else None
+
+    def predict(self, features, rows, phase_out=None):
+        if self.fail:
+            raise RuntimeError("injected engine failure")
+        if phase_out is not None:
+            phase_out["pad"] = 0.001
+            phase_out["compute"] = 0.002
+            phase_out["unpack"] = 0.0005
+        return np.ones((rows, 2), np.float32), self.step
+
+
+class _Stack:
+    """One in-process replica behind a router: the full traced path
+    FleetRouter -> InProcessServingClient -> ServingServicer ->
+    DynamicBatcher -> FakeEngine."""
+
+    def __init__(self, trace_sample_rate=1.0, fail=False):
+        self.engine = FakeEngine(fail=fail)
+        self.batcher = DynamicBatcher(self.engine, max_latency_s=0.001)
+        self.servicer = ServingServicer(self.engine, self.batcher)
+        self.router = FleetRouter(
+            clients={0: InProcessServingClient(self.servicer)},
+            retry_policy=_no_sleep_policy(),
+            trace_sample_rate=trace_sample_rate,
+        )
+        self.request = make_predict_request(
+            {"x": np.zeros((2, 4), np.float32)}
+        )
+
+    def close(self):
+        self.batcher.shutdown()
+
+
+def _spans(records):
+    return [r for r in records if r.get("event") == events.PREDICT_SPAN]
+
+
+# ---- deterministic sampling ---------------------------------------------
+
+
+def test_every_kth_sampling_and_request_id_echo(records):
+    stack = _Stack(trace_sample_rate=0.5)  # k=2: every 2nd request
+    try:
+        for i in range(1, 7):
+            resp = stack.router.predict(stack.request)
+            assert resp.code == spb.SERVING_OK
+            # every response carries the router-minted id, sampled or not
+            assert resp.request_id == f"rq-{i:08d}"
+    finally:
+        stack.close()
+    spans = _spans(records)
+    # requests 2/4/6 sampled in, each with two halves (servicer+router)
+    assert sorted({s["request_id"] for s in spans}) == [
+        "rq-00000002", "rq-00000004", "rq-00000006",
+    ]
+    assert len(spans) == 6
+    assert all(s["reason"] == "sampled" for s in spans)
+
+
+def test_sampling_disabled_emits_no_spans(records):
+    stack = _Stack(trace_sample_rate=0.0)
+    try:
+        for _ in range(4):
+            assert stack.router.predict(stack.request).code == spb.SERVING_OK
+    finally:
+        stack.close()
+    assert _spans(records) == []
+
+
+def test_span_halves_carry_all_phases(records):
+    stack = _Stack(trace_sample_rate=1.0)
+    try:
+        resp = stack.router.predict(stack.request)
+        assert resp.code == spb.SERVING_OK
+    finally:
+        stack.close()
+    spans = _spans(records)
+    assert len(spans) == 2
+    servicer_half, router_half = spans  # servicer emits before the router
+    assert set(servicer_half["phases_s"]) == {
+        "queue_wait", "batch_form", "pad", "compute", "unpack", "respond",
+    }
+    assert servicer_half["model_step"] == 7
+    assert servicer_half["rows"] == 2
+    assert servicer_half["code"] == int(spb.SERVING_OK)
+    assert set(router_half["phases_s"]) == {"route"}
+    # both halves name the same request and stay inside the vocabulary
+    assert servicer_half["request_id"] == router_half["request_id"]
+    assert set(servicer_half["phases_s"]) <= events.SPAN_PHASES
+    assert servicer_half["reason"] in events.SPAN_REASONS
+
+
+# ---- forensic outcomes bypass sampling ----------------------------------
+
+
+class _SheddingClient:
+    def predict(self, request, timeout=None):
+        return spb.PredictResponse(code=spb.SERVING_OVERLOADED)
+
+
+class _DeadClient:
+    def predict(self, request, timeout=None):
+        raise ConnectionError("replica killed")
+
+
+def test_whole_fleet_shed_is_always_captured(records):
+    router = FleetRouter(
+        clients={0: _SheddingClient(), 1: _SheddingClient()},
+        retry_policy=_no_sleep_policy(),
+        trace_sample_rate=0.0,  # sampling off: forensics still capture
+    )
+    resp = router.predict(spb.PredictRequest())
+    assert resp.code == spb.SERVING_OVERLOADED
+    (span,) = _spans(records)
+    assert span["reason"] == "shed"
+    assert span["request_id"] == "rq-00000001"
+    assert span["code"] == int(spb.SERVING_OVERLOADED)
+    assert "route" in span["phases_s"]
+
+
+def test_exhausted_fleet_error_is_always_captured(records):
+    from elasticdl_tpu.common.resilience import RetryBudgetExhausted
+
+    router = FleetRouter(
+        clients={0: _DeadClient()},
+        retry_policy=_no_sleep_policy(max_attempts=2),
+        trace_sample_rate=0.0,
+    )
+    with pytest.raises(RetryBudgetExhausted):
+        router.predict(spb.PredictRequest())
+    (span,) = _spans(records)
+    assert span["reason"] == "error"
+    assert span["error"] == "RetryBudgetExhausted"
+    assert span["request_id"] == "rq-00000001"
+
+
+def test_failover_is_always_captured(records):
+    stack = _Stack(trace_sample_rate=0.0)
+    try:
+        stack.router.set_client(1, _DeadClient())
+        # replica 1 errors first in some sweep: drive until a failover
+        # is recorded, then the span for that request must exist
+        for _ in range(4):
+            resp = stack.router.predict(stack.request)
+            assert resp.code == spb.SERVING_OK
+            if stack.router.stats()["failovers"]["error"]:
+                break
+    finally:
+        stack.close()
+    assert stack.router.stats()["failovers"]["error"] >= 1
+    spans = _spans(records)
+    assert spans, "failover must capture a span despite sampling off"
+    assert spans[-1]["reason"] == "failover"
+    assert spans[-1]["code"] == int(spb.SERVING_OK)
+
+
+def test_invalid_decode_captures_both_halves(records):
+    stack = _Stack(trace_sample_rate=1.0)
+    try:
+        resp = stack.router.predict(spb.PredictRequest())  # no inputs
+    finally:
+        stack.close()
+    assert resp.code == spb.SERVING_INVALID
+    assert resp.request_id == "rq-00000001"
+    reasons = [s["reason"] for s in _spans(records)]
+    assert reasons == ["invalid", "invalid"]  # servicer half + router half
+
+
+def test_internal_engine_failure_is_always_captured(records):
+    stack = _Stack(trace_sample_rate=0.0, fail=True)
+    try:
+        resp = stack.router.predict(stack.request)
+    finally:
+        stack.close()
+    assert resp.code == spb.SERVING_INTERNAL
+    (span,) = _spans(records)
+    assert span["reason"] == "internal"
+
+
+# ---- the phase histogram + health ride-along ----------------------------
+
+
+def test_phase_histogram_and_health_scalars():
+    from elasticdl_tpu.common import metrics as metrics_lib
+
+    stack = _Stack(trace_sample_rate=1.0)
+    try:
+        for _ in range(3):
+            assert stack.router.predict(stack.request).code == spb.SERVING_OK
+        snap = stack.batcher.metrics.snapshot()
+        assert snap["phase_queue_wait_p99_s"] >= 0.0
+        assert snap["phase_compute_p99_s"] >= 0.002  # engine stamps 2ms
+        text = metrics_lib.render_text([stack.batcher.metrics.registry])
+        assert 'serving_request_phase_seconds' in text
+        assert 'phase="compute"' in text
+        # the Health RPC republishes the p99 scalars the fleet manager's
+        # probe reads into `elasticdl top`'s per-replica columns
+        health = stack.servicer.health(spb.HealthRequest(), None)
+        by_name = {m.name: m.value for m in health.metrics}
+        assert by_name["phase_compute_p99_s"] >= 0.002
+        assert "phase_queue_wait_p99_s" in by_name
+    finally:
+        stack.close()
+
+
+def test_top_fleet_table_shows_phase_p99_columns():
+    from elasticdl_tpu.client.top import render
+
+    frame = render({
+        "snapshot": {
+            "tasks": {},
+            "serving_fleet": {
+                "replicas": {
+                    "0": {
+                        "addr": "j-serving-0", "healthy": True,
+                        "model_step": 5, "fill_ratio": 0.5, "shed": 0,
+                        "queue_wait_p99_s": 0.0031,
+                        "compute_p99_s": 0.0122, "incarnation": 0,
+                    },
+                },
+            },
+        },
+    })
+    assert "qwait_p99" in frame and "comp_p99" in frame
+    assert "3.1ms" in frame and "12.2ms" in frame
+
+
+# ---- `elasticdl trace` on a mixed train+serve log -----------------------
+
+
+def _drive_mixed_log(log_path):
+    """One event log holding a full train-task chain AND routed serve
+    requests: sampled-in (rq-2), sampled-out (rq-1, absent from the
+    log), and an always-captured whole-fleet error (rq-3)."""
+    events.configure(log_path, role="master")
+    base = time.time()
+    for offset, name in enumerate((
+        events.TASK_DISPATCHED, events.TASK_CLAIMED,
+        events.TASK_TRAINED, events.TASK_REPORTED,
+    )):
+        events.emit(name, task_id=1, worker_id=0, ts=base + offset)
+    stack = _Stack(trace_sample_rate=0.5)
+    try:
+        for _ in range(2):  # rq-1 sampled out, rq-2 sampled in
+            assert stack.router.predict(stack.request).code == spb.SERVING_OK
+        # kill the only replica: rq-3 exhausts the sweep and is captured
+        # as an error span despite being sampled out
+        from elasticdl_tpu.common.resilience import RetryBudgetExhausted
+
+        stack.router.set_client(0, _DeadClient())
+        with pytest.raises(RetryBudgetExhausted):
+            stack.router.predict(stack.request)
+    finally:
+        stack.close()
+    events.configure(None)
+
+
+def test_trace_renders_serving_slices_next_to_tasks(tmp_path):
+    from elasticdl_tpu.client.trace import build_chrome_trace, summarize
+
+    log = str(tmp_path / "mixed.jsonl")
+    _drive_mixed_log(log)
+    evts = events.read_events(log)
+
+    doc = build_chrome_trace(evts)
+    names = [e.get("name") for e in doc["traceEvents"]]
+    # the train side still renders as task slices
+    assert "task 1" in names
+    # the sampled-in request is a top slice with nested phase segments
+    request_slices = [
+        e for e in doc["traceEvents"]
+        if e.get("cat") == "request" and e.get("ph") == "X"
+    ]
+    by_name = {e["name"] for e in request_slices}
+    assert "request rq-00000002" in by_name
+    segments = {
+        e["name"] for e in request_slices
+        if e.get("args", {}).get("request_id") == "rq-00000002"
+    }
+    assert {"queue_wait", "batch_form", "compute"} <= segments
+    # the sampled-out request never minted a wire id: absent entirely
+    assert not any("rq-00000001" in str(n) for n in names)
+    # the error span is present (always-capture) and flagged as such
+    flagged = [
+        e for e in doc["traceEvents"]
+        if e.get("cat") == "request"
+        and e.get("args", {}).get("reason") == "error"
+    ]
+    assert flagged, "error span must render despite sampling"
+    assert flagged[0]["args"]["request_id"] == "rq-00000003"
+    # serving requests live on their own named track
+    serving_pids = {e["pid"] for e in request_slices}
+    track_names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "serving" in track_names
+    task_pids = {
+        e["pid"] for e in doc["traceEvents"] if e.get("cat") == "task"
+    }
+    assert serving_pids.isdisjoint(task_pids)
+
+    text = summarize(evts)
+    assert "tasks completed: 1" in text
+    assert "serve requests traced: 2 (1 forensic" in text
+    assert "queue_wait" in text and "compute" in text
+    assert "error" in text
+
+
+def test_trace_cli_end_to_end_on_mixed_log(tmp_path, capsys):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    log = str(tmp_path / "mixed.jsonl")
+    _drive_mixed_log(log)
+    out_path = str(tmp_path / "trace.json")
+    rc = cli_main(["trace", log, "--chrome", out_path, "--summary"])
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "serve requests traced: 2" in printed
+    with open(out_path) as fh:
+        doc = json.load(fh)
+    assert any(
+        e.get("cat") == "request" for e in doc["traceEvents"]
+    )
+
+
+# ---- graftlint: spans must be correlatable ------------------------------
+
+
+def test_lint_rule_flags_untraceable_predict_spans():
+    from scripts.graftlint.rules_metrics import find_untraced_predict_spans
+
+    bad = ast.parse(
+        "events.emit(events.PREDICT_SPAN, reason='sampled')\n"
+        "events.emit(events.PREDICT_SPAN, request_id=rid)\n"
+        "events.emit(events.PREDICT_SPAN, request_id=rid, reason=why)\n"
+        "events.emit(events.PREDICT_SPAN, request_id=rid,"
+        " reason='bogus')\n"
+        "events.emit(events.PREDICT_SPAN, request_id=rid,"
+        " reason='sampled', phase='warp')\n"
+    )
+    messages = [m for _, m in find_untraced_predict_spans(bad)]
+    assert len(messages) == 5
+    assert any("request_id" in m for m in messages)
+    assert any("computed value" in m for m in messages)
+    assert any("'bogus'" in m for m in messages)
+    assert any("'warp'" in m for m in messages)
+
+    good = ast.parse(
+        "events.emit(events.PREDICT_SPAN, request_id=rid,"
+        " reason='failover', phases_s=phases)\n"
+        "events.emit(events.OTHER_EVENT, whatever=1)\n"
+    )
+    assert list(find_untraced_predict_spans(good)) == []
+
+
+def test_production_emit_sites_pass_the_lint_rule():
+    from scripts.graftlint.rules_metrics import find_untraced_predict_spans
+
+    for path in (
+        "elasticdl_tpu/proto/service.py",
+        "elasticdl_tpu/serving/server.py",
+        "elasticdl_tpu/common/flight.py",
+    ):
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        assert list(find_untraced_predict_spans(tree)) == [], path

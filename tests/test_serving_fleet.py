@@ -6,6 +6,7 @@ seeded fault plan, with byte-stable decision/event traces across
 same-seed runs (docs/SERVING.md "Fleet", docs/ROBUSTNESS.md)."""
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -13,6 +14,8 @@ import numpy as np
 import pytest
 
 from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import flight
+from elasticdl_tpu.common.flight import FlightRecorder
 from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
@@ -102,7 +105,7 @@ class _Fleet:
     wired through injectable collaborators — no sockets, no pods."""
 
     def __init__(self, tmp_path, skew_slo=0, probe_failures=2,
-                 with_freshness=False):
+                 with_freshness=False, traced=False):
         self.spec = get_model_spec("model_zoo", MODEL_DEF)
         self.sample = np.random.RandomState(0).rand(2, 784).astype(
             np.float32
@@ -117,12 +120,24 @@ class _Fleet:
         self.latest_step = None
         self.save_step(1)
 
+        self.clock = FakeClock()
         self.replicas = {}
         for rid in range(REPLICAS):
             engine = ServingEngine.from_checkpoint(
                 self.ckpt_dir, self.spec, self.sample, buckets=BUCKETS
             )
-            batcher = DynamicBatcher(engine, max_latency_s=0.002)
+            if traced:
+                # per-request span phases on the fake clock: every timed
+                # hop collapses to 0.0s deterministically, so captured
+                # spans are byte-stable across same-seed runs (requests
+                # are one full bucket, so dispatch never waits on the
+                # frozen latency deadline)
+                engine.clock = self.clock
+                batcher = DynamicBatcher(
+                    engine, max_latency_s=0.002, clock=self.clock
+                )
+            else:
+                batcher = DynamicBatcher(engine, max_latency_s=0.002)
             reloader = CheckpointReloader(
                 engine, self.ckpt_dir, poll_interval_s=3600.0
             )
@@ -134,14 +149,14 @@ class _Fleet:
             }
 
         self.k8s = FakeK8sClient()
-        self.clock = FakeClock()
         # End-to-end freshness on the fake clock: the staleness the
         # router scores per response is fully tick-determined.
         self.freshness = (
             FreshnessTracker(clock=self.clock) if with_freshness else None
         )
         self.router = FleetRouter(
-            retry_policy=_no_sleep_policy(), freshness=self.freshness
+            retry_policy=_no_sleep_policy(), freshness=self.freshness,
+            **({"clock": self.clock} if traced else {}),
         )
         self.manager = ServingFleetManager(
             self.k8s,
@@ -222,6 +237,10 @@ class _StubHealthClient:
             metrics=[
                 spb.ScalarMetric(name="batch_fill_ratio", value=0.5),
                 spb.ScalarMetric(name="shed", value=3.0),
+                spb.ScalarMetric(
+                    name="phase_queue_wait_p99_s", value=0.012
+                ),
+                spb.ScalarMetric(name="phase_compute_p99_s", value=0.034),
             ],
         )
 
@@ -257,6 +276,10 @@ def test_placement_and_probe_bookkeeping():
     assert snap["replicas"][2]["model_step"] == 9
     assert snap["replicas"][0]["fill_ratio"] == 0.5
     assert snap["replicas"][0]["shed"] == 3
+    # serve-phase p99 scalars ride the probe into `elasticdl top`'s
+    # per-replica qwait_p99/comp_p99 columns
+    assert snap["replicas"][0]["queue_wait_p99_s"] == 0.012
+    assert snap["replicas"][0]["compute_p99_s"] == 0.034
     assert snap["model_step_skew"] == 6  # 9 - 3, probes feed the gauge
     assert router.observed_step_skew() == 6
     manager.stop()  # no-op, must not raise
@@ -494,14 +517,28 @@ def _staleness_chaos_run(tmp_path, event_log):
     on the fake clock.  The windowed p99 crosses the 2s objective, the
     fast burn crosses 10x, `slo_breach` fires; once the retried swaps
     land and the stall's observations age out of the 8s window,
-    `slo_recovered` closes the loop.  Client traffic rides through."""
+    `slo_recovered` closes the loop.  Client traffic rides through.
+
+    The flight recorder rides the whole run the way the master wires it
+    (`--incident_dir`): tapping the event stream for request spans and
+    decisions, with the evaluator's `on_breach` hook capturing a bundle
+    in the same tick the breach is decided."""
     events.configure(event_log, role="master")
-    f = _Fleet(tmp_path, skew_slo=0, with_freshness=True)
+    f = _Fleet(tmp_path, skew_slo=0, with_freshness=True, traced=True)
     history = MetricHistory(
         registries=[f.freshness.metrics_registry], clock=f.clock
     )
+    recorder = FlightRecorder(
+        incident_dir=str(tmp_path / "incidents"),
+        snapshot_fn=lambda: {
+            "serving_fleet": f.manager.snapshot(),
+            "slo": evaluator.snapshot(),
+        },
+        history=history,
+    ).install()
     evaluator = SloEvaluator(
-        history, specs=[_staleness_spec()], clock=f.clock
+        history, specs=[_staleness_spec()], clock=f.clock,
+        on_breach=recorder.breach,
     )
     reg = faults.install(FaultRegistry(
         [
@@ -527,7 +564,15 @@ def _staleness_chaos_run(tmp_path, event_log):
             "slo": list(evaluator.decisions),
         }
         freshness = f.freshness.snapshot()
+        flight_snap = recorder.snapshot()
+        bundles = flight.list_bundles(str(tmp_path / "incidents"))
+        bundle_files = {}
+        for manifest in bundles:
+            for name in sorted(os.listdir(manifest["path"])):
+                with open(os.path.join(manifest["path"], name), "rb") as fh:
+                    bundle_files[f"{manifest['bundle']}/{name}"] = fh.read()
     finally:
+        recorder.close()
         f.close()
         faults.uninstall()
         events.configure(None)
@@ -539,6 +584,9 @@ def _staleness_chaos_run(tmp_path, event_log):
         "events": _slo_event_projection(events.read_events(event_log)),
         "trace": reg.trace_text(),
         "registry": reg,
+        "flight": flight_snap,
+        "bundles": bundles,
+        "bundle_files": bundle_files,
     }
 
 
@@ -580,6 +628,43 @@ def test_staleness_slo_burns_and_recovers_under_reload_stall(tmp_path):
     assert run["freshness"]["observations"] == 26
     assert run["freshness"]["staleness_p99_s"] > 2.0
 
+    # the breach auto-captured exactly one incident bundle in the tick
+    # it was decided (deduped against the tap's copy, re-armed only by
+    # recovery — which came after the single burn)
+    assert run["flight"]["captured"] == ["incident-0001-slo_breach"]
+    (manifest,) = run["bundles"]
+    assert manifest["trigger"] == "slo_breach"
+    assert manifest["evidence"]["slo"] == SLO_STALENESS_P99
+    assert manifest["evidence"]["fast_burn"] >= 10.0
+    bundle = flight.load_bundle(manifest["path"])
+    # the ring holds the stalled-window request spans: both halves per
+    # routed request, every phase inside the closed vocabulary, and the
+    # served step pinned at 1 (the stall is the evidence)
+    spans = bundle["spans"]
+    assert len(spans) >= 6
+    assert all(
+        set(s["phases_s"]) <= events.SPAN_PHASES for s in spans
+    )
+    servicer_halves = [s for s in spans if "model_step" in s]
+    assert servicer_halves
+    assert all(s["model_step"] == 1 for s in servicer_halves)
+    assert any("queue_wait" in s["phases_s"] for s in spans)
+    assert any("route" in s["phases_s"] for s in spans)
+    # the SLO decision that tripped the capture rides the bundle too,
+    # with the run-variant fields stripped
+    breach_records = [
+        d for d in bundle["decisions"] if d["event"] == "slo_breach"
+    ]
+    assert breach_records and breach_records[0]["slo"] == SLO_STALENESS_P99
+    assert all(
+        "ts" not in r and "pid" not in r
+        for r in spans + bundle["decisions"]
+    )
+    # and the master-side evidence: SLO table + fleet state at capture
+    assert bundle["master"]["slo"]["slos"][0]["state"] == STATE_BREACH
+    assert bundle["master"]["serving_fleet"]["reload_steps"] == 0
+    assert bundle["history"]["series"]
+
 
 def test_staleness_slo_trace_is_byte_stable(tmp_path):
     run_a = _staleness_chaos_run(
@@ -593,6 +678,11 @@ def test_staleness_slo_trace_is_byte_stable(tmp_path):
     assert run_a["trace"] == run_b["trace"]
     assert run_a["states"] == run_b["states"]
     assert run_a["codes"] == run_b["codes"]
+    # the auto-captured incident bundle is byte-identical file-for-file:
+    # deterministic request ids, fake-clock phases, volatile fields
+    # stripped, sort_keys everywhere
+    assert run_a["bundle_files"]
+    assert run_a["bundle_files"] == run_b["bundle_files"]
 
 
 # ---- `elasticdl slo` against a live fleet --------------------------------
