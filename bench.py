@@ -1407,6 +1407,356 @@ def bench_sparse_path(batch_size: int = 65536):
     }
 
 
+def bench_tiered(
+    parity_steps: int = 8,
+    parity_batch: int = 128,
+    throughput_steps: int = 24,
+    throughput_batch: int = 128,
+):
+    """Tiered embedding store bench (`python bench.py --tiered`,
+    docs/PERF.md "Tiered embedding store").  Four sub-benches:
+
+    1. EXACT parity vs the flat arena on an all-hot working set: the
+       host tier is backfilled from the flat model's init table over a
+       collision-free id subset, so every admitted cache row starts at
+       the flat value and the two training runs must stay bitwise
+       identical (losses, predictions, and the trained rows themselves).
+    2. Cache efficacy on the canonical zipfian stream (the same config
+       `scripts/store_summary.py` prints in CI): hit rate + lazy growth.
+    3. A beyond-budget config the flat arena cannot run: under a
+       declared device-embedding byte budget, the flat table's
+       params+Adam-moments footprint exceeds the budget while the tiered
+       run holds only the fixed cache on device and grows the full
+       vocabulary in host RAM — and the vocabulary it actually grows
+       exceeds the largest flat table the budget could hold.
+    4. Equal-vocab throughput, flat vs tiered, on the zipfian stream —
+       plus the cold-gather overlap share (fraction of host-gather
+       seconds absorbed by the prefetcher thread instead of the
+       consumer's critical path).
+    """
+    import time as _time
+
+    import jax
+
+    from elasticdl_tpu.layers.embedding import hash_ids_host
+    from elasticdl_tpu.store.tiered import TieredStore
+    from model_zoo.deepfm.deepfm_functional_api import NUM_SPARSE
+    from scripts.store_summary import zipfian_batches, zipfian_summary
+
+    detail = {}
+
+    def hash_rows(fields, ids, cap):
+        # host replica of field_offset_ids + hash_ids(mix=True) for
+        # arbitrary (field, id) pairs (hash_field_rows_host wants the
+        # full (B, 26) matrix)
+        with np.errstate(over="ignore"):
+            fid = (
+                np.asarray(ids).astype(np.uint32)
+                + np.asarray(fields).astype(np.uint32)
+                * np.uint32(0x61C88647)
+            )
+        return hash_ids_host(fid, cap, mix=True)
+
+    # ---- 1. exact parity on an all-hot working set ---------------------
+    cap, dim, cache_rows, ids_per_field = 1 << 14, 8, 2048, 40
+    rng = np.random.RandomState(7)
+    cand = rng.randint(0, 1 << 22, size=(NUM_SPARSE, ids_per_field * 8))
+    cand_rows = hash_rows(
+        np.repeat(np.arange(NUM_SPARSE)[:, None], cand.shape[1], 1),
+        cand, cap,
+    )
+    # collision-free subset: every (field, id) pair must own its flat
+    # row alone, else flat trains two ids in one row while the tiered
+    # store trains them apart and parity is (correctly) impossible
+    seen = set()
+    sel = np.zeros((NUM_SPARSE, ids_per_field), np.int32)
+    for f in range(NUM_SPARSE):
+        picked = 0
+        for j in range(cand.shape[1]):
+            row = int(cand_rows[f, j])
+            if row not in seen:
+                seen.add(row)
+                sel[f, picked] = cand[f, j]
+                picked += 1
+                if picked == ids_per_field:
+                    break
+        assert picked == ids_per_field, "hash space too small for subset"
+
+    def parity_batch_at(step):
+        brng = np.random.RandomState(1000 + step)
+        pick = brng.randint(0, ids_per_field, (parity_batch, NUM_SPARSE))
+        return {
+            "features": {
+                "dense": brng.rand(parity_batch, 13).astype(np.float32),
+                "sparse": sel[np.arange(NUM_SPARSE)[None, :], pick],
+            },
+            "labels": brng.randint(0, 2, parity_batch).astype(np.int32),
+        }
+
+    _, flat_tr = _trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        model_params=f"vocab_capacity={cap};embed_dim={dim}",
+    )
+    _, tier_tr = _trainer_for(
+        "deepfm.deepfm_tiered.custom_model",
+        model_params=f"cache_rows={cache_rows};embed_dim={dim}",
+    )
+    b0 = parity_batch_at(0)
+    flat_state = flat_tr.init_state(jax.random.PRNGKey(0), b0["features"])
+    tier_state = tier_tr.init_state(
+        jax.random.PRNGKey(0),
+        {
+            "dense": b0["features"]["dense"],
+            "slots": np.zeros((parity_batch, NUM_SPARSE), np.int32),
+        },
+    )
+    flat_init = {
+        name: np.array(
+            flat_state.params["params"][name]["embedding"], np.float32
+        )
+        for name in ("fm_embedding", "fm_linear")
+    }
+    store = TieredStore(
+        {"fm_embedding": dim, "fm_linear": 1}, NUM_SPARSE, cache_rows
+    )
+    # admitted rows start at the flat model's init values, so the two
+    # runs share their step-0 state exactly
+    store.host.set_backfill(
+        lambda plane, fields, ids: flat_init[plane][
+            hash_rows(fields, ids, cap)
+        ]
+    )
+    tier_tr.tiered_store = store
+
+    max_loss_diff = 0.0
+    for step in range(parity_steps):
+        batch = parity_batch_at(step)
+        flat_state, flat_loss = flat_tr.train_on_batch(flat_state, batch)
+        tier_state, tier_loss = tier_tr.train_on_batch(
+            tier_state,
+            store.attach(
+                {"features": dict(batch["features"]),
+                 "labels": batch["labels"]}
+            ),
+        )
+        max_loss_diff = max(
+            max_loss_diff,
+            abs(float(jax.device_get(flat_loss))
+                - float(jax.device_get(tier_loss))),
+        )
+
+    probe = parity_batch_at(10_000)
+    flat_pred = np.asarray(jax.device_get(
+        flat_tr.predict_on_batch(flat_state, probe["features"])
+    ))
+    slots, _plan = store.prepare(probe["features"]["sparse"])
+    tier_pred = np.asarray(jax.device_get(
+        tier_tr.predict_on_batch(
+            tier_state,
+            {"dense": probe["features"]["dense"], "slots": slots},
+        )
+    ))
+    # the trained rows themselves: flat row value vs tiered cache slot
+    flat_emb = np.asarray(jax.device_get(
+        flat_state.params["params"]["fm_embedding"]["embedding"]
+    ))
+    tier_emb = np.asarray(jax.device_get(
+        tier_state.params["params"]["fm_embedding"]["embedding"]
+    ))
+    probe_rows = hash_rows(
+        np.arange(NUM_SPARSE)[None, :], probe["features"]["sparse"], cap
+    )
+    row_diff = float(np.abs(
+        flat_emb[probe_rows] - tier_emb[slots]
+    ).max())
+    pred_diff = float(np.abs(flat_pred - tier_pred).max())
+    detail["parity"] = {
+        "steps": parity_steps,
+        "batch_size": parity_batch,
+        "working_set_rows": int(NUM_SPARSE * ids_per_field),
+        "cache_rows": cache_rows,
+        "max_abs_loss_diff": max_loss_diff,
+        "max_abs_trained_row_diff": row_diff,
+        # Train-path parity is the bitwise claim: per-step losses prove
+        # the forward program, trained rows prove the backward.  Predict
+        # compiles a SEPARATE program per model (different gather table
+        # shapes -> different XLA fusion order), so its diff is allowed
+        # to be a few ulp and is reported, not gated on.
+        "exact": bool(max_loss_diff == 0.0 and row_diff == 0.0),
+        "predict_max_abs_diff": pred_diff,
+        "predict_within_few_ulp": bool(pred_diff <= 4 * np.finfo(np.float32).eps),
+    }
+
+    # ---- 2. zipfian cache efficacy (the STORE_SUMMARY config) ----------
+    hit_rate, growth_rows = zipfian_summary()
+    detail["zipfian"] = {
+        "hit_rate": round(hit_rate, 4),
+        "growth_rows": int(growth_rows),
+    }
+
+    # ---- 3. beyond-budget config the flat arena cannot run -------------
+    budget_bytes = 4 << 20       # declared device-embedding budget
+    big_dim, big_cache = 16, 4096
+    # fp32 params + Adam m + v, both planes (dim + the dim-1 linear)
+    bytes_per_row = (big_dim + 1) * 4 * 3
+    flat_rows_wanted = 1 << 20   # the north-star flat config
+    flat_rows_affordable = budget_bytes // bytes_per_row
+    _, big_tr = _trainer_for(
+        "deepfm.deepfm_tiered.custom_model",
+        model_params=f"cache_rows={big_cache};embed_dim={big_dim}",
+    )
+    big_store = TieredStore(
+        {"fm_embedding": big_dim, "fm_linear": 1}, NUM_SPARSE, big_cache
+    )
+    big_tr.tiered_store = big_store
+    big_store.start()
+    brng = np.random.RandomState(11)
+    big_state = big_tr.init_state(
+        jax.random.PRNGKey(0),
+        {"dense": np.zeros((128, 13), np.float32),
+         "slots": np.zeros((128, NUM_SPARSE), np.int32)},
+    )
+    growth_curve = []
+    for _ in range(20):
+        batch = {
+            "features": {
+                "dense": brng.rand(128, 13).astype(np.float32),
+                # uniform over the raw id space: nearly every id is new,
+                # the flat-killing regime (no head to cache)
+                "sparse": brng.randint(
+                    0, 1 << 22, (128, NUM_SPARSE)
+                ).astype(np.int32),
+            },
+            "labels": brng.randint(0, 2, 128).astype(np.int32),
+        }
+        big_state, big_loss = big_tr.train_on_batch(
+            big_state, big_store.attach(batch)
+        )
+        growth_curve.append(big_store.host.size)
+    jax.device_get(big_loss)
+    big_store.stop()
+    big_stats = big_store.stats()
+    detail["beyond_budget"] = {
+        "device_embedding_budget_bytes": budget_bytes,
+        "flat_rows_wanted": flat_rows_wanted,
+        "flat_bytes_wanted": flat_rows_wanted * bytes_per_row,
+        "flat_rows_affordable": int(flat_rows_affordable),
+        "flat_cannot_run": bool(
+            flat_rows_wanted * bytes_per_row > budget_bytes
+        ),
+        "tiered_device_bytes": big_cache * bytes_per_row,
+        "tiered_fits_budget": bool(
+            big_cache * bytes_per_row <= budget_bytes
+        ),
+        "vocab_rows_grown": big_stats["vocab_rows"],
+        "vocab_exceeds_affordable_flat": bool(
+            big_stats["vocab_rows"] > flat_rows_affordable
+        ),
+        "host_tier_bytes": big_stats["host_bytes"],
+        "growth_curve_rows": growth_curve,
+        "train_steps_run": len(growth_curve),
+    }
+
+    # ---- 4. equal-vocab throughput + cold-gather overlap ---------------
+    tp_cap, tp_dim, tp_cache = 1 << 14, 16, 4096
+    stream = zipfian_batches(
+        steps=throughput_steps + 4, batch=throughput_batch
+    )
+    dense = np.random.RandomState(3).rand(
+        throughput_batch, 13
+    ).astype(np.float32)
+    labels = np.random.RandomState(4).randint(
+        0, 2, throughput_batch
+    ).astype(np.int32)
+
+    def batch_at(i, sparse_dtype=np.int32):
+        return {
+            "features": {
+                "dense": dense,
+                "sparse": stream[i].astype(sparse_dtype),
+            },
+            "labels": labels,
+        }
+
+    _, flat_tp = _trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        model_params=f"vocab_capacity={tp_cap};embed_dim={tp_dim}",
+    )
+    fstate = flat_tp.init_state(
+        jax.random.PRNGKey(0), batch_at(0)["features"]
+    )
+    for i in range(4):           # warm-up: compile
+        fstate, floss = flat_tp.train_on_batch(fstate, batch_at(i))
+    jax.device_get(floss)
+    t0 = _time.perf_counter()
+    for i in range(4, 4 + throughput_steps):
+        fstate, floss = flat_tp.train_on_batch(fstate, batch_at(i))
+    jax.device_get(floss)
+    flat_eps = throughput_steps * throughput_batch / (
+        _time.perf_counter() - t0
+    )
+
+    _, tier_tp = _trainer_for(
+        "deepfm.deepfm_tiered.custom_model",
+        model_params=f"cache_rows={tp_cache};embed_dim={tp_dim}",
+    )
+    from elasticdl_tpu.common.profiler import PhaseTimer
+
+    timer = PhaseTimer(flush_every=1 << 30)
+    tp_store = TieredStore(
+        {"fm_embedding": tp_dim, "fm_linear": 1}, NUM_SPARSE, tp_cache,
+        phase_timer=timer,
+    )
+    tier_tp.tiered_store = tp_store
+    tp_store.start()
+    tstate = tier_tp.init_state(
+        jax.random.PRNGKey(0),
+        {"dense": dense,
+         "slots": np.zeros((throughput_batch, NUM_SPARSE), np.int32)},
+    )
+    for i in range(4):
+        tstate, tloss = tier_tp.train_on_batch(
+            tstate, tp_store.attach(batch_at(i))
+        )
+    jax.device_get(tloss)
+    t0 = _time.perf_counter()
+    for i in range(4, 4 + throughput_steps):
+        tstate, tloss = tier_tp.train_on_batch(
+            tstate, tp_store.attach(batch_at(i))
+        )
+    jax.device_get(tloss)
+    tier_s = _time.perf_counter() - t0
+    tier_eps = throughput_steps * throughput_batch / tier_s
+    tp_store.stop()
+    tp_stats = tp_store.stats()
+    detail["throughput"] = {
+        "flat_vocab_capacity": tp_cap,
+        "cache_rows": tp_cache,
+        "embed_dim": tp_dim,
+        "batch_size": throughput_batch,
+        "steps": throughput_steps,
+        "flat_examples_per_sec": round(flat_eps, 1),
+        "tiered_examples_per_sec": round(tier_eps, 1),
+        "tiered_vs_flat": round(tier_eps / max(flat_eps, 1e-9), 3),
+        "hit_rate": round(tp_stats["hit_rate"], 4),
+        "cold_gather_overlap_share": round(
+            tp_stats["cold_gather_overlap_share"], 3
+        ),
+        "cold_gather_async_s": round(tp_stats["cold_gather_async_s"], 4),
+        "cold_gather_sync_s": round(tp_stats["cold_gather_sync_s"], 4),
+        "cold_gather_share_of_wall": round(
+            (tp_stats["cold_gather_async_s"]
+             + tp_stats["cold_gather_sync_s"]) / tier_s, 4
+        ),
+    }
+    return {
+        "bench": "tiered",
+        "value": detail["throughput"]["tiered_examples_per_sec"],
+        "unit": "examples/sec",
+        "detail": detail,
+    }
+
+
 def _maybe_attach_metrics(result):
     """--emit-metrics: append the unified registry's snapshot to the
     bench JSON, so a bench run doubles as an instrumentation check (the
@@ -1438,6 +1788,7 @@ def main():
               "serving_fleet": bench_serving_fleet,
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
+              "tiered": bench_tiered,
               "e2e": lambda: bench_deepfm_e2e()}[which]
         print(json.dumps(post(fn())))
 

@@ -158,6 +158,55 @@ def _train_local(args, job_type: str = "train") -> int:
         checkpoint_steps=args.checkpoint_steps,
     )
 
+    # Tiered embedding store (elasticdl_tpu/store): a zoo module that
+    # exports build_tiered_store() opts into the host-RAM bulk tier +
+    # device hot-row cache.  The Local path never calls Master.start()
+    # (the PR 10 gotcha), so the store's background threads — cold-miss
+    # prefetcher, host-fold worker — must start HERE.
+    tiered_store = None
+    build_tiered_store = getattr(spec.module, "build_tiered_store", None)
+    if build_tiered_store is not None and job_type == "train":
+        if args.num_workers != 1:
+            raise ValueError(
+                "tiered embedding store requires --num_workers 1: cache "
+                "admission plans must be prepared and applied in strict "
+                "batch order by one producer/consumer pair"
+            )
+        if getattr(args, "steps_per_execution", 1) != 1:
+            raise ValueError(
+                "tiered embedding store requires --steps_per_execution 1:"
+                " each step's admissions must land on the state before "
+                "that step runs, which a fused multi-step dispatch "
+                "cannot interleave"
+            )
+        if args.validation_data:
+            raise ValueError(
+                "tiered embedding store does not support mid-train "
+                "evaluation yet: the eval path prepares admission plans "
+                "it never applies, corrupting the cache map — drop "
+                "--validation_data for tiered runs"
+            )
+        # Default registry so /metrics serves store_* next to the worker
+        # families; the worker's PhaseTimer so cold-gather time lands in
+        # worker_step_phase_seconds{phase="cold_gather"}.
+        from elasticdl_tpu.common import metrics as metrics_lib
+        from elasticdl_tpu.worker.worker import _phase_timer
+
+        tiered_store = build_tiered_store(
+            registry=metrics_lib.default_registry(),
+            phase_timer=_phase_timer,
+        )
+        spec.feed = tiered_store.wrap_feed(spec.feed)
+        spec.feed_bulk = tiered_store.wrap_feed(spec.feed_bulk)
+        owner.trainer.tiered_store = tiered_store
+        if owner.checkpoint_saver is not None:
+            owner.checkpoint_saver.attach_tiered_store(tiered_store)
+        tiered_store.start()
+        logger.info(
+            "Tiered embedding store active: cache_rows=%d host_dtype=%s",
+            tiered_store.cache_rows, tiered_store.host.host_dtype,
+        )
+
     # A restored task journal may already be terminal; the finish check
     # must run once proactively (it also injects the final-eval round for
     # the restored model) since no training report will ever drain the
@@ -197,6 +246,9 @@ def _train_local(args, job_type: str = "train") -> int:
     ok = master.wait()
     for thread in threads:
         thread.join(timeout=60)
+    if tiered_store is not None:
+        # drain pending eviction write-backs, then stop both threads
+        tiered_store.stop()
     if master.slo_evaluator is not None:
         master.slo_evaluator.stop()
     if master.metric_history is not None:

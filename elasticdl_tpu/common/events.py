@@ -50,6 +50,8 @@ SLO_BREACH = "slo_breach"          # burn rate crossed an alert threshold
 SLO_RECOVERED = "slo_recovered"    # burn rate back inside the budget
 PREDICT_SPAN = "predict_span"      # one routed serve request, all phases
 INCIDENT_CAPTURED = "incident_captured"  # flight recorder wrote a bundle
+STORE_GROWN = "store_grown"        # tiered store lazily grew vocab rows
+STORE_TIER_SWAPPED = "store_tier_swapped"  # serving adopted tier metadata
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -61,7 +63,7 @@ VOCABULARY = frozenset({
     RECOVERY_STARTED, RECOVERY_DONE, STEP_PHASES, STRAGGLER_DETECTED,
     POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
     FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED, PREDICT_SPAN,
-    INCIDENT_CAPTURED,
+    INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every

@@ -37,7 +37,8 @@ _LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 # First `_`-separated token of every metric name.
 KNOWN_SUBSYSTEMS = frozenset(
-    {"master", "worker", "serving", "data", "rpc", "faults", "process"}
+    {"master", "worker", "serving", "data", "rpc", "faults", "process",
+     "store"}
 )
 
 # Trailing unit token(s).  `_total` marks counters (Prometheus convention),
@@ -351,6 +352,10 @@ class MetricsRegistry:
         fam = self._register(name, lambda: _GaugeFnFamily(name, fn, help))
         if not isinstance(fam, _GaugeFnFamily):
             raise ValueError(f"{name} already registered as {fam.kind}")
+        # Latest registrant wins: a re-created component (get-or-create
+        # registries outlive job-scoped objects) must not leave the
+        # gauge reading a dead instance.
+        fam._fn = fn
         return fam
 
     def histogram(self, name: str, help: str = "", min_value: float = 1e-4,

@@ -49,7 +49,15 @@ class StepTimer:
 #: The step-phase vocabulary (docs/OBSERVABILITY.md "Phase catalogue").
 #: Every phase a worker attributes step time to; the labeled
 #: `worker_step_phase_seconds{phase=...}` histogram uses exactly these.
-STEP_PHASES = ("data_wait", "pack", "h2d_stage", "compute", "report")
+STEP_PHASES = (
+    "data_wait", "pack", "h2d_stage", "compute", "report",
+    # tiered embedding store (elasticdl_tpu/store): host-tier gathers for
+    # cold rows — on the prefetcher thread when overlapped, on the
+    # consumer when a deferred row forces a synchronous gather.  Its
+    # `share` vs `compute` is the cold-tail overlap measurement
+    # bench.py --tiered reports.
+    "cold_gather",
+)
 
 
 class PhaseTimer:

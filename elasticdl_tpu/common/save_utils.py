@@ -217,6 +217,7 @@ class CheckpointSaver:
         # detect truncated/corrupted checkpoints and fall back.
         self._manifest_dir = os.path.join(self._dir, ".manifests")
         os.makedirs(self._manifest_dir, exist_ok=True)
+        self._async_save = bool(async_save)
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -231,6 +232,68 @@ class CheckpointSaver:
         # producer freshness stamp per saved step, same cached-at-save
         # pattern — the train-to-serve staleness trace starts here
         self._produced_meta: Dict[int, Dict[str, Any]] = {}
+        # tiered embedding store (elasticdl_tpu/store): when attached,
+        # save() writes a sidecar (host planes + vocab + cache map) next
+        # to each step and restores load it back into the store
+        self._tiered_store = None
+        self._tiered_meta: Dict[int, Dict[str, Any]] = {}
+
+    def attach_tiered_store(self, store) -> None:
+        """Couple a TieredStore to this saver: each save() writes the
+        store's sidecar for the step, and each restore re-adopts the
+        sidecar matching the restored step."""
+        self._tiered_store = store
+
+    def restore_raw(self, step: int):
+        """Restore a step WITHOUT a template — the stored tree as orbax
+        recorded it (dicts/lists of host arrays).  The tiered<->flat
+        migration helpers path-match against this."""
+        import orbax.checkpoint as ocp
+
+        return self._mngr.restore(step, args=ocp.args.StandardRestore())
+
+    def _save_tiered_sidecar(self, step: int, state) -> None:
+        if self._tiered_store is None:
+            return
+        from elasticdl_tpu.store import checkpoint as store_ckpt
+
+        try:
+            store_ckpt.save_sidecar(self._dir, step,
+                                    self._tiered_store, state)
+            store = self._tiered_store
+            self._tiered_meta[step] = {
+                "cache_rows": int(store.cache_rows),
+                "vocab_rows": int(store.host.size),
+                "host_dtype": store.host.host_dtype,
+                "planes": {
+                    name: int(dim) for name, dim in store.planes.items()
+                },
+            }
+        except Exception:
+            logger.exception("tiered sidecar save failed")
+
+    def _load_tiered_sidecar(self, step: int) -> None:
+        if self._tiered_store is None:
+            return
+        from elasticdl_tpu.store import checkpoint as store_ckpt
+
+        if not store_ckpt.has_sidecar(self._dir, step):
+            # A flat checkpoint restored into a tiered run: legitimate
+            # (migration path) — the store keeps its current (usually
+            # fresh) host state and lazily backfills.
+            logger.info(
+                "checkpoint step %d has no tiered sidecar; store state "
+                "not restored", step,
+            )
+            return
+        sidecar = store_ckpt.load_sidecar(self._dir, step)
+        self._tiered_store.load_sidecar_state(
+            sidecar.host_state, sidecar.row_of, sidecar.score
+        )
+        logger.info(
+            "tiered store sidecar restored for step %d "
+            "(vocab_rows=%d)", step, sidecar.meta.get("vocab_rows", -1),
+        )
 
     def save(self, state, force: bool = False) -> bool:
         import orbax.checkpoint as ocp
@@ -245,6 +308,24 @@ class CheckpointSaver:
             logger.warning("checkpoint save skipped (%s)", exc)
             return False
         step = int(state.step)
+        if self._async_save:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                # Orbax's async save snapshots device buffers to host
+                # before the background write, but on the CPU backend
+                # that snapshot can be a zero-copy VIEW of the live
+                # buffer — the next donating train step rewrites it in
+                # place and the "step N" checkpoint silently captures
+                # step N+1 values (same aliasing family as
+                # parallel/collectives.host_snapshot).  Copy eagerly;
+                # on accelerators the D2H transfer orbax performs is
+                # already an owning copy, so no gate needed there.
+                from elasticdl_tpu.parallel.collectives import (
+                    host_snapshot,
+                )
+
+                state = host_snapshot(state)
         try:
             self._arena_meta[step] = _arena_meta_of(state)
         except Exception:
@@ -253,6 +334,9 @@ class CheckpointSaver:
             "model_step": step,
             "produced_unix_s": round(float(self._clock()), 6),
         }
+        # Sidecar BEFORE the (async) orbax save: the cache-value read
+        # must precede the next donating train step.
+        self._save_tiered_sidecar(step, state)
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
@@ -289,6 +373,10 @@ class CheckpointSaver:
                 if ext == ".json" and stem.isdigit() \
                         and int(stem) not in steps:
                     os.remove(os.path.join(self._manifest_dir, name))
+            if self._tiered_store is not None:
+                from elasticdl_tpu.store import checkpoint as store_ckpt
+
+                store_ckpt.prune_sidecars(self._dir, steps)
         except Exception:
             logger.exception("checkpoint manifest refresh failed")
 
@@ -313,6 +401,10 @@ class CheckpointSaver:
         # serving swap so every replica knows the age of its model
         if step in self._produced_meta:
             manifest["produced"] = self._produced_meta[step]
+        # tiered store layout (cache size, planes, vocab at save time) —
+        # what the serving side needs to know BEFORE loading the sidecar
+        if step in self._tiered_meta:
+            manifest["tiered"] = self._tiered_meta[step]
         path = self._manifest_path(step)
         tmp = path + ".tmp"
         # temp file + os.replace: readers only ever see a complete
@@ -522,6 +614,7 @@ class CheckpointSaver:
         restored = self._restore_with_shims(step, abstract)
         if convert is not None:
             restored = convert(restored)
+        self._load_tiered_sidecar(step)
         logger.info("Restored checkpoint step %d (eval-at-version)", step)
         events.emit(events.CHECKPOINT_RESTORED, step=step)
         return restored
@@ -623,6 +716,7 @@ class CheckpointSaver:
                     "back to the previous good step", step, exc,
                 )
                 continue
+            self._load_tiered_sidecar(step)
             logger.info("Restored checkpoint step %d", step)
             events.emit(events.CHECKPOINT_RESTORED, step=step)
             return restored

@@ -182,6 +182,37 @@ def is_packed_dedup(obj) -> bool:
     return isinstance(obj, dict) and set(obj) == DEDUP_KEYS
 
 
+def frequency_rank(values: np.ndarray):
+    """(uniques in descending-frequency order, matching counts) for a 1-D
+    id/row column.  THE admission signal of the tiered embedding store
+    (elasticdl_tpu/store): the dedup wire format already computes this
+    ranking per field to build its 1-byte inverse plane, and the hot-row
+    cache pins exactly the same head of the distribution, so exporting
+    it keeps the two frequency views from drifting.
+
+    Same bincount-vs-np.unique strategy as `pack_rows_dedup`: dense
+    (hashed / store-row) ranges rank in O(B + range) with no sort; only
+    absurdly sparse ranges fall back to np.unique.  Ties break toward
+    the smaller value (stable argsort over a sorted unique list)."""
+    values = np.asarray(values).reshape(-1)
+    if values.size == 0:
+        return (
+            np.empty(0, values.dtype if values.dtype != bool else np.int64),
+            np.empty(0, np.int64),
+        )
+    if values.min() < 0:
+        raise ValueError("frequency_rank needs non-negative ids/rows")
+    hi = int(values.max()) + 1
+    if hi <= max(4 * values.size, 1 << 20):
+        counts = np.bincount(values, minlength=hi)
+        uniq = np.nonzero(counts)[0]
+        counts = counts[uniq]
+    else:
+        uniq, counts = np.unique(values, return_counts=True)
+    order = np.argsort(-counts, kind="stable")
+    return uniq[order], counts[order].astype(np.int64)
+
+
 def pack_rows_dedup(
     rows: np.ndarray, unique_pad: int = 0, exc_pad: int = 0
 ) -> dict:

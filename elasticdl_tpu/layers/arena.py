@@ -90,6 +90,28 @@ def dequantize_rows(q8, scale):
     return q8.astype(jnp.float32) * scale
 
 
+def quantize_rows_host(table: "np.ndarray"):
+    """numpy mirror of `quantize_rows` for the tiered store's host tier
+    (elasticdl_tpu/store/host_tier.py): fp32 (R, D) -> (int8 codes,
+    fp32 (R, 1) scales), bit-identical numerics to the device version.
+    Lives HERE because GL-QUANT sanctions plane arithmetic only in this
+    module — the host tier stores and indexes the planes but never does
+    math on them."""
+    table = np.asarray(table, np.float32)
+    max_abs = np.max(np.abs(table), axis=1, keepdims=True) \
+        if table.size else np.zeros((table.shape[0], 1), np.float32)
+    scale = np.where(max_abs > 0, max_abs / _Q_MAX, 1.0).astype(np.float32)
+    q8 = np.clip(
+        np.round(table / scale), -_Q_MAX, _Q_MAX
+    ).astype(np.int8)
+    return q8, scale
+
+
+def dequantize_rows_host(q8: "np.ndarray", scale: "np.ndarray"):
+    """numpy mirror of `dequantize_rows` (see quantize_rows_host)."""
+    return q8.astype(np.float32) * np.asarray(scale, np.float32)
+
+
 def stochastic_round(x, key):
     """Unbiased integer rounding: floor(x + U[0,1)), so E[result] == x
     and exact integers return exactly (floor(k + u) == k for u < 1) —
@@ -285,6 +307,54 @@ class EmbeddingArena(nn.Module):
             parts.append(rows.reshape(x.shape[0], -1).astype(np.int32))
             offset += int(capacity)
         return np.concatenate(parts, axis=1)
+
+
+class TieredArena(nn.Module):
+    """Device half of the tiered embedding store (elasticdl_tpu/store).
+
+    Where `EmbeddingArena` holds the FULL vocabulary in HBM, this module
+    holds only a `cache_rows`-row hot cache; the full (lazily grown)
+    vocabulary lives in the store's host-RAM tier.  The cache table is
+    the ONLY trainable storage — the store's admission plan guarantees
+    every row a training batch touches is cache-resident before the step
+    runs, so the jitted train step is structurally identical to the flat
+    arena's (one gather forward, one scatter-add backward) and
+    numerically identical on an all-hot working set.
+
+    Call with `slots` (..., F) int32 CACHE slots (from
+    TieredStore.prepare).  Training always passes resident slots
+    (>= 0).  Serving may pass `slot == -1` for cold/unknown ids together
+    with `overlay` — a (..., F, dim) plane of host-gathered values for
+    exactly those positions; overlay values are stop_gradient'ed (cold
+    rows train host-side via the store's fold path, never through the
+    device optimizer).
+    """
+
+    cache_rows: int
+    output_dim: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, slots, overlay=None):
+        # Same initializer as the flat arena: a slot that is never
+        # admitted before first use behaves like a fresh flat-arena row.
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.05),
+            (int(self.cache_rows), self.output_dim),
+            self.param_dtype,
+        )
+        rows = jnp.asarray(slots)
+        flat = rows.reshape(-1)
+        hot = _lookup(table, jnp.maximum(flat, 0)).reshape(
+            rows.shape + (self.output_dim,)
+        )
+        if overlay is None:
+            return hot
+        cold = jax.lax.stop_gradient(
+            jnp.asarray(overlay).astype(hot.dtype)
+        )
+        return jnp.where((rows >= 0)[..., None], hot, cold)
 
 
 def arena_table_from_feature_tables(

@@ -1,0 +1,33 @@
+"""Tiered embedding store: host-RAM bulk tier + device hot-row cache.
+
+The flat `EmbeddingArena` must fit the whole vocabulary in HBM; this
+package keeps the full (lazily grown) vocabulary in host RAM — fp32 or
+int8+scales, reusing the arena's quantized-plane numerics — and pins
+only a hot-row cache on device.  The cache table is the model's ONLY
+trainable embedding storage: every row a batch touches is admitted
+before the step runs, so the jitted train step stays structurally
+identical to the flat arena's and bitwise-identical on an all-hot
+working set.  Cold rows are gathered from the host tier on the prefetch
+thread (overlapped with compute) and written back host-side on
+eviction.
+
+Module layout:
+  host_tier.py   host-RAM planes + lazy vocabulary (numpy only)
+  cache.py       hot-row cache bookkeeping + per-batch admission plans
+  device.py      the ONE sanctioned device seam (GL-BOUNDARY allowlist)
+  tiered.py      TieredStore orchestrator + background threads
+  checkpoint.py  sidecar save/load + tiered<->flat migration
+  serving.py     TieredServingEngine (cold-row lookup on Predict)
+"""
+
+from elasticdl_tpu.store.cache import CachePlan, HotRowCache
+from elasticdl_tpu.store.host_tier import HostTier, LazyVocabulary
+from elasticdl_tpu.store.tiered import TieredStore
+
+__all__ = [
+    "CachePlan",
+    "HotRowCache",
+    "HostTier",
+    "LazyVocabulary",
+    "TieredStore",
+]

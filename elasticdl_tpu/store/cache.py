@@ -1,0 +1,170 @@
+"""Device hot-row cache bookkeeping + per-batch admission plans.
+
+Pure numpy/host bookkeeping — the cache's VALUES live in the model's
+`TieredArena` param on device; this module only decides which store row
+occupies which cache slot.
+
+Admission is mandatory: every row a training batch touches must be
+cache-resident before the step runs (gradients flow only through the
+device table).  Per batch the cache:
+
+  1. frequency-ranks the batch's unique rows (`wire.frequency_rank` —
+     the dedup wire format's signal, reused as the admission policy);
+  2. counts hits (resident BEFORE this batch's admissions) vs misses;
+  3. fills empty slots first, then evicts the lowest-score resident
+     rows NOT in the current batch (score = decayed lookup frequency;
+     ties break on lowest slot index, so planning is deterministic);
+  4. returns a `CachePlan` the TieredStore executes at apply time.
+
+Raises if a single batch references more unique rows than the cache
+holds — that configuration cannot satisfy the every-touched-row-resident
+invariant and must fail loudly, not thrash.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.data.wire import frequency_rank
+
+
+@dataclass
+class CachePlan:
+    """One batch's admission/eviction schedule.
+
+    `slots` is what the model consumes; the admit/evict arrays are what
+    `TieredStore.apply_plan` executes against device + host tiers.
+    `deferred` marks admits whose host value is still in-flight on the
+    fold queue (evicted recently, write-back pending) — those are
+    gathered synchronously at apply time, after a fold-queue flush.
+    """
+
+    slots: np.ndarray                 # (B, F) int32 cache slots
+    admit_slots: np.ndarray           # (K,) int32
+    admit_rows: np.ndarray            # (K,) int64 store rows
+    evict_slots: np.ndarray           # (E,) int32
+    evict_rows: np.ndarray            # (E,) int64 store rows
+    hits: int
+    misses: int
+    growth: int = 0                   # vocab rows grown by this batch
+    deferred: Optional[np.ndarray] = None   # (K,) bool
+    prefetch_rows: Optional[np.ndarray] = None  # admit_rows[~deferred]
+    admit_values: Dict[str, np.ndarray] = field(default_factory=dict)
+    ready: threading.Event = field(default_factory=threading.Event)
+
+
+class HotRowCache:
+    """Slot bookkeeping for the device-resident hot-row cache.
+
+    NOT thread-safe on its own — always driven under TieredStore's lock
+    (plans must be produced sequentially anyway: slot assignment is
+    stateful).
+    """
+
+    def __init__(self, capacity: int, decay: float = 0.999):
+        if capacity < 1:
+            raise ValueError("cache needs at least one row")
+        self.capacity = int(capacity)
+        self._decay = float(decay)
+        self._slot_of: Dict[int, int] = {}      # store row -> slot
+        self.row_of = np.full(self.capacity, -1, np.int64)
+        self._score = np.zeros(self.capacity, np.float64)
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._slot_of)
+
+    def slot_of(self, row: int) -> int:
+        """Resident slot for a store row, or -1 (the serving path)."""
+        return self._slot_of.get(int(row), -1)
+
+    def plan(self, rows: np.ndarray) -> CachePlan:
+        rows = np.asarray(rows, np.int64)
+        flat = rows.reshape(-1)
+        uniq, counts = frequency_rank(flat)
+        if uniq.size > self.capacity:
+            raise ValueError(
+                f"batch touches {uniq.size} unique rows but the cache "
+                f"holds {self.capacity}; shrink the batch or grow the "
+                f"cache — thrashing within one step is not supported"
+            )
+        resident = np.fromiter(
+            (int(r) in self._slot_of for r in uniq), bool, uniq.size
+        )
+        hits = int(counts[resident].sum())
+        misses = int(counts[~resident].sum())
+        admit_rows = uniq[~resident]          # descending frequency
+
+        # Victim selection: empty slots first, then lowest-score resident
+        # rows outside the current batch (those are guaranteed to exist:
+        # free + non-batch-resident >= capacity - batch_uniques >= admits).
+        free = np.nonzero(self.row_of < 0)[0]
+        n_free = min(free.size, admit_rows.size)
+        admit_slots = free[:n_free].astype(np.int64)
+        need = admit_rows.size - n_free
+        if need > 0:
+            cand = np.nonzero(
+                (self.row_of >= 0) & ~np.isin(self.row_of, uniq)
+            )[0]
+            order = cand[np.lexsort((cand, self._score[cand]))]
+            evict_slots = order[:need]
+        else:
+            evict_slots = np.empty(0, np.int64)
+        evict_rows = self.row_of[evict_slots].copy()
+
+        # Commit the bookkeeping NOW (plans are produced ahead of
+        # execution; the next plan must see this one's assignments).
+        for s, r in zip(evict_slots, evict_rows):
+            del self._slot_of[int(r)]
+        admit_slots = np.concatenate([admit_slots, evict_slots])
+        for s, r in zip(admit_slots, admit_rows):
+            self._slot_of[int(r)] = int(s)
+            self.row_of[s] = r
+            self._score[s] = 0.0
+
+        # Frequency scores: decay everything, bump this batch's rows.
+        self._score *= self._decay
+        uniq_slots = np.fromiter(
+            (self._slot_of[int(r)] for r in uniq), np.int64, uniq.size
+        )
+        self._score[uniq_slots] += counts
+
+        # Row -> slot translation for the full batch.
+        order = np.argsort(uniq, kind="stable")
+        uniq_sorted, slot_sorted = uniq[order], uniq_slots[order]
+        slots = slot_sorted[np.searchsorted(uniq_sorted, flat)]
+        return CachePlan(
+            slots=slots.reshape(rows.shape).astype(np.int32),
+            admit_slots=admit_slots.astype(np.int32),
+            admit_rows=admit_rows.copy(),
+            evict_slots=evict_slots.astype(np.int32),
+            evict_rows=evict_rows,
+            hits=hits,
+            misses=misses,
+        )
+
+    # ---- serialization -------------------------------------------------
+
+    def state_arrays(self):
+        """(row_of, score) — enough to rebuild residency after restore."""
+        return self.row_of.copy(), self._score.copy()
+
+    def load_state_arrays(self, row_of: np.ndarray,
+                          score: Optional[np.ndarray] = None) -> None:
+        row_of = np.asarray(row_of, np.int64)
+        if row_of.shape != (self.capacity,):
+            raise ValueError(
+                f"cache map shape {row_of.shape} != ({self.capacity},)"
+            )
+        self.row_of = row_of.copy()
+        self._slot_of = {
+            int(r): int(s) for s, r in enumerate(row_of) if r >= 0
+        }
+        self._score = (
+            np.asarray(score, np.float64).copy()
+            if score is not None else np.zeros(self.capacity, np.float64)
+        )

@@ -1,0 +1,272 @@
+"""Tiered checkpoint sidecar + tiered<->flat migration.
+
+Orbax owns the TrainState (which, for a tiered model, contains the
+device cache tables); everything else the store needs to resume — host
+planes, the lazy vocabulary, the cache residency map — rides in a
+SIDECAR under `<checkpoint_dir>/.tiered/<step>/`, written synchronously
+by `CheckpointSaver.save()` and pruned with the same rotation as the
+step dirs.  The sidecar is self-contained: it also carries a copy of
+the cache VALUES at save time, so serving and migration can reconstruct
+every vocabulary row's latest value without interpreting the orbax tree.
+
+Migration ("arena_convert-style", both directions):
+
+* tiered -> flat: `flat_tables_from_sidecar` materialises full
+  (capacity, dim) flat-arena tables by hashing every vocabulary id with
+  the flat model's hash and scattering its latest value (cache value if
+  resident, else host-tier value).  Hash collisions resolve to the
+  EARLIEST-assigned store row — deterministic, and matching the flat
+  arena's first-writer-wins intuition.  Unmapped flat rows keep the
+  template's init.
+
+* flat -> tiered: `fill_matching` copies every same-path, same-shape
+  leaf (the dense layers) from a raw restored tree into a tiered
+  template; the cache starts empty and the host tier lazily backfills
+  rows from the flat tables via `flat_backfill` instead of
+  re-initialising them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.layers.arena import dequantize_rows_host
+
+logger = get_logger(__name__)
+
+SIDECAR_ROOT = ".tiered"
+
+
+def sidecar_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(checkpoint_dir), SIDECAR_ROOT, str(int(step))
+    )
+
+
+def has_sidecar(checkpoint_dir: str, step: int) -> bool:
+    return os.path.isfile(
+        os.path.join(sidecar_dir(checkpoint_dir, step), "meta.json")
+    )
+
+
+def save_sidecar(checkpoint_dir: str, step: int, store, state) -> str:
+    """Write the store's host/bookkeeping state for `step`.  Runs
+    synchronously inside CheckpointSaver.save() — the cache-value read
+    must happen before the next (donating) train step rewrites the
+    state's buffers."""
+    from elasticdl_tpu.store import device as store_device
+
+    d = sidecar_dir(checkpoint_dir, step)
+    os.makedirs(d, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for key, value in store.host.state_dict().items():
+        arrays[f"host__{key}"] = value
+    row_of, score = store.cache.state_arrays()
+    arrays["cache__row_of"] = row_of
+    arrays["cache__score"] = score
+    for name, table in store_device.read_full_tables(
+            state, store.param_paths).items():
+        arrays[f"values__{name}"] = table
+
+    npz_path = os.path.join(d, "store.npz")
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, npz_path)
+
+    meta = {
+        "step": int(step),
+        "cache_rows": int(store.cache_rows),
+        "num_fields": int(store.num_fields),
+        "host_dtype": store.host.host_dtype,
+        "planes": {name: int(dim) for name, dim in store.planes.items()},
+        "vocab_rows": int(store.host.size),
+    }
+    meta_path = os.path.join(d, "meta.json")
+    tmp = meta_path + ".tmp"
+    # meta.json lands LAST via os.replace: its presence marks a complete
+    # sidecar (has_sidecar keys off it), so readers never see a torn one.
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, meta_path)
+    return d
+
+
+@dataclass
+class TieredSidecar:
+    meta: dict
+    host_state: Dict[str, np.ndarray]
+    row_of: np.ndarray                 # (cache_rows,) store row per slot
+    score: np.ndarray
+    cache_values: Dict[str, np.ndarray]   # plane -> (cache_rows, dim)
+
+    def host_plane(self, name: str) -> np.ndarray:
+        """Full (vocab_rows, dim) fp32 view of a host plane."""
+        if self.meta["host_dtype"] == "fp32":
+            return np.asarray(self.host_state[f"plane_{name}_fp32"],
+                              np.float32)
+        return dequantize_rows_host(
+            self.host_state[f"plane_{name}_codes"],
+            self.host_state[f"plane_{name}_scales"],
+        )
+
+    def vocab_arrays(self):
+        return (
+            np.asarray(self.host_state["vocab_fields"], np.int64),
+            np.asarray(self.host_state["vocab_ids"], np.int64),
+            np.asarray(self.host_state["vocab_rows"], np.int64),
+        )
+
+    def latest_row_values(self, name: str) -> np.ndarray:
+        """(vocab_rows, dim) fp32: host-tier values, overridden by the
+        cache value for every resident row — each row's freshest state
+        at save time."""
+        values = self.host_plane(name).copy()
+        resident = self.row_of >= 0
+        slots = np.nonzero(resident)[0]
+        rows = self.row_of[slots]
+        in_range = rows < values.shape[0]
+        values[rows[in_range]] = self.cache_values[name][slots[in_range]]
+        return values
+
+
+def load_sidecar(checkpoint_dir: str, step: int) -> TieredSidecar:
+    d = sidecar_dir(checkpoint_dir, step)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    host_state: Dict[str, np.ndarray] = {}
+    row_of = score = None
+    cache_values: Dict[str, np.ndarray] = {}
+    with np.load(os.path.join(d, "store.npz")) as npz:
+        for key in npz.files:
+            if key.startswith("host__"):
+                host_state[key[len("host__"):]] = npz[key]
+            elif key == "cache__row_of":
+                row_of = npz[key]
+            elif key == "cache__score":
+                score = npz[key]
+            elif key.startswith("values__"):
+                cache_values[key[len("values__"):]] = npz[key]
+    return TieredSidecar(meta, host_state, row_of, score, cache_values)
+
+
+def prune_sidecars(checkpoint_dir: str, keep_steps) -> None:
+    """Drop sidecars of rotated-away steps (same policy as manifests)."""
+    root = os.path.join(os.path.abspath(checkpoint_dir), SIDECAR_ROOT)
+    if not os.path.isdir(root):
+        return
+    keep = {str(int(s)) for s in keep_steps}
+    import shutil
+
+    for name in os.listdir(root):
+        if name.isdigit() and name not in keep:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+# ---- migration: tiered -> flat ----------------------------------------
+
+
+def flat_tables_from_sidecar(
+    sidecar: TieredSidecar,
+    templates: Dict[str, np.ndarray],
+    hash_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+) -> Dict[str, np.ndarray]:
+    """Materialise flat-arena tables from a tiered sidecar.
+
+    `templates`: per plane, a freshly initialised (capacity, dim) table
+    — unmapped rows keep this init.  `hash_fn(fields, ids) -> flat rows`
+    is the flat model's id hashing (e.g. deepfm's hash_field_rows_host
+    over field-offset ids).
+    """
+    fields, ids, rows = sidecar.vocab_arrays()
+    flat_rows = np.asarray(hash_fn(fields, ids), np.int64)
+    # Descending store-row scatter: duplicates resolve last-write-wins,
+    # so the EARLIEST-assigned vocabulary row claims a collided flat row.
+    order = np.argsort(-rows, kind="stable")
+    out = {}
+    for name, template in templates.items():
+        table = np.array(template, np.float32, copy=True)
+        values = sidecar.latest_row_values(name)[rows]
+        table[flat_rows[order]] = values[order]
+        out[name] = table
+    return out
+
+
+def flat_backfill(
+    flat_tables: Dict[str, np.ndarray],
+    hash_fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+):
+    """HostTier backfill source pulling grown rows out of restored flat
+    tables — the lazy half of flat -> tiered migration."""
+
+    def backfill(plane: str, fields: np.ndarray,
+                 ids: np.ndarray) -> np.ndarray:
+        table = flat_tables.get(plane)
+        if table is None:
+            return None
+        flat_rows = np.asarray(
+            hash_fn(np.asarray(fields, np.int64),
+                    np.asarray(ids, np.int64)),
+            np.int64,
+        )
+        return np.asarray(table, np.float32)[flat_rows]
+
+    return backfill
+
+
+# ---- migration: path-matched tree fill --------------------------------
+
+
+def _walk(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, prefix + (str(k),))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _walk(v, prefix + (str(i),))
+    else:
+        yield prefix, tree
+
+
+def fill_matching(template, raw):
+    """Copy every leaf of `raw` whose normalized path AND shape match
+    into a copy of `template` (dict keys and sequence indices both
+    normalize to strings, so an orbax raw tree — which renders tuples as
+    lists and int-keyed dicts as str-keyed — still lines up).  Leaves
+    with no match keep the template's value: that is exactly what lets a
+    flat arena table (capacity, dim) coexist with a tiered cache table
+    (cache_rows, dim) under the same name across a migration."""
+    raw_map = {path: leaf for path, leaf in _walk(raw)}
+
+    def rebuild(node, prefix):
+        if isinstance(node, dict):
+            return {
+                k: rebuild(v, prefix + (str(k),)) for k, v in node.items()
+            }
+        if isinstance(node, (list, tuple)):
+            return type(node)(
+                rebuild(v, prefix + (str(i),)) for i, v in enumerate(node)
+            )
+        leaf = raw_map.get(prefix)
+        if (
+            leaf is not None
+            and hasattr(leaf, "shape") and hasattr(node, "shape")
+            and tuple(leaf.shape) == tuple(node.shape)
+        ):
+            out = np.asarray(leaf)
+            if hasattr(node, "dtype") and out.dtype != node.dtype:
+                out = out.astype(node.dtype)
+            return out
+        return node
+
+    return rebuild(template, ())

@@ -1,0 +1,209 @@
+"""The tiered store's ONE device seam (GL-BOUNDARY allowlisted).
+
+Every device interaction the store needs — scatter admitted rows into
+the cache param, zero their optimizer moments, read rows back for
+eviction write-back or checkpointing — funnels through this module so
+the rest of `store/` stays host-plane numpy (and graftlint can keep
+flagging device APIs anywhere else under `store/`).
+
+All entry points route through `run_device_serialized`: on the tier-1
+box the mesh is 8 virtual devices on one CPU core, and two threads
+dispatching concurrently wedge the backend (see trainer._CPU_EXEC_LOCK).
+
+Index vectors are BUCKET-PADDED: the admit count K varies per batch,
+and jax compiles per shape — an unpadded scatter would recompile every
+time a new K shows up (measured 40x+ step-time inflation on the CPU
+box).  Padding K up to a power-of-four bucket caps the distinct shapes
+at ~log4(cache_rows).  The pad entries repeat index 0 with its REAL
+value, so duplicate writes are idempotent and the result is exactly
+the unpadded scatter's.
+
+On top of the padding, the per-plane gathers/scatters are FUSED into
+one jitted program per call site (cache keyed on the static plane
+layout; jax's own jit cache handles the bucket shapes).  The eager
+version of apply_admissions cost ~6 separate dispatches per step —
+fusing them cut apply time ~5x on the tier-1 box.
+
+Reads return OWNING numpy copies (`np.array(..., copy=True)`): the
+train step donates its state (`donate_argnums=(0,)`), so a zero-copy
+view of a device buffer would be rewritten under us by the next step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.worker.trainer import run_device_serialized
+
+
+def _get_in(tree, path: Tuple[str, ...]):
+    node = tree
+    for key in path:
+        node = node[key]
+    return node
+
+
+def _set_in(tree, path: Tuple[str, ...], value):
+    """Functional nested-dict set: copies only the dicts along `path`."""
+    if not path:
+        return value
+    out = dict(tree)
+    out[path[0]] = _set_in(tree[path[0]], path[1:], value)
+    return out
+
+
+def _pad_bucket(n: int) -> int:
+    """Smallest power-of-FOUR >= n (floor 64): caps the distinct gather/
+    scatter shapes XLA ever sees from this module at ~log4(cache_rows),
+    so compile churn burns out within a few warm-up steps.  The extra
+    padded rows are idempotent duplicate writes — wasted bandwidth only,
+    and at most 4x of it."""
+    size = 64
+    while size < n:
+        size <<= 2
+    return size
+
+
+def _pad_indices(idx: np.ndarray) -> np.ndarray:
+    """Pad an index vector to its bucket by repeating index 0."""
+    padded = np.full(_pad_bucket(idx.size), idx[0], idx.dtype)
+    padded[: idx.size] = idx
+    return padded
+
+
+def _layout(param_paths: Dict[str, Tuple[str, ...]]
+            ) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
+    """Hashable, order-stable (name, path) tuple — the static key the
+    fused-program caches below hang off."""
+    return tuple(sorted(param_paths.items()))
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_program(layout):
+    paths = tuple(path for _, path in layout)
+
+    @jax.jit
+    def gather(params, idx):
+        return tuple(_get_in(params, path)[idx] for path in paths)
+
+    return gather
+
+
+def read_rows(state, param_paths: Dict[str, Tuple[str, ...]],
+              slots: np.ndarray) -> Dict[str, np.ndarray]:
+    """Owning fp32 copies of cache rows `slots`, per plane — the
+    eviction write-back read."""
+    n = int(np.asarray(slots).size)
+    idx = _pad_indices(np.asarray(slots, np.int32))
+    layout = _layout(param_paths)
+    gather = _gather_program(layout)
+
+    def _read():
+        rows = gather(state.params, idx)
+        return {
+            name: np.array(jax.device_get(t), np.float32, copy=True)[:n]
+            for (name, _), t in zip(layout, rows)
+        }
+
+    return run_device_serialized(_read)
+
+
+def read_full_tables(state, param_paths: Dict[str, Tuple[str, ...]],
+                     ) -> Dict[str, np.ndarray]:
+    """Owning fp32 copies of the whole cache table per plane (sidecar
+    checkpointing — cache tables are small by construction)."""
+
+    def _read():
+        out = {}
+        for name, path in param_paths.items():
+            table = _get_in(state.params, path)
+            out[name] = np.array(
+                jax.device_get(table), np.float32, copy=True
+            )
+        return out
+
+    return run_device_serialized(_read)
+
+
+def apply_admissions(state, param_paths: Dict[str, Tuple[str, ...]],
+                     slots: np.ndarray,
+                     values: Dict[str, np.ndarray]):
+    """Scatter host-gathered row values into every plane's cache param
+    and zero those rows' optimizer moments.
+
+    Moment zeroing makes an admitted row behave exactly like a
+    never-touched flat-arena row: in Adam, an untouched row's mu/nu stay
+    zero, so a row that leaves and re-enters the cache must not carry
+    moments from its previous residency.
+    """
+    n = int(np.asarray(slots).size)
+    idx = _pad_indices(np.asarray(slots, np.int32))
+    layout = _layout(param_paths)
+
+    def _pad_values(vals: np.ndarray) -> np.ndarray:
+        # pad rows repeat row 0: every duplicate write carries the same
+        # value, so the padded scatter equals the unpadded one
+        padded = np.repeat(vals[:1], idx.size, axis=0)
+        padded[:n] = vals
+        return padded
+
+    vals = tuple(
+        _pad_values(np.asarray(values[name], np.float32))
+        for name, _ in layout
+    )
+    admit = _admit_program(layout)
+
+    def _apply():
+        params, opt_state = admit(state.params, state.opt_state, idx, vals)
+        return state.replace(params=params, opt_state=opt_state)
+
+    return run_device_serialized(_apply)
+
+
+@functools.lru_cache(maxsize=None)
+def _admit_program(layout):
+    paths = tuple(path for _, path in layout)
+
+    @jax.jit
+    def admit(params, opt_state, idx, vals):
+        for path, v in zip(paths, vals):
+            table = _get_in(params, path)
+            params = _set_in(
+                params, path, table.at[idx].set(v.astype(table.dtype))
+            )
+
+        # Optax moment trees share the params' pytree structure
+        # (trainer.state_sharding uses the same trick); zero the admitted
+        # rows in every such subtree.  All of this tree walking happens
+        # at trace time — the compiled program is just fused scatters.
+        param_treedef = jax.tree.structure(params)
+
+        def is_param_like(subtree):
+            try:
+                return jax.tree.structure(subtree) == param_treedef
+            except Exception:
+                return False
+
+        def zero_rows(subtree):
+            if not is_param_like(subtree):
+                return subtree
+            for path in paths:
+                leaf = _get_in(subtree, path)
+                subtree = _set_in(
+                    subtree, path,
+                    leaf.at[idx].set(jnp.zeros((), leaf.dtype)),
+                )
+            return subtree
+
+        opt_state = jax.tree.map(
+            zero_rows, opt_state, is_leaf=is_param_like
+        )
+        return params, opt_state
+
+    return admit

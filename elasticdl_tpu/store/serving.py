@@ -1,0 +1,164 @@
+"""TieredServingEngine: cold-row lookup on Predict + tiered hot swap.
+
+Wraps a plain `ServingEngine` whose model is the TIERED zoo variant
+(reads `slots` + per-plane cold overlays).  Clients keep sending raw
+`{dense, sparse}` features; this wrapper translates ids through the
+sidecar's vocabulary + cache map:
+
+  resident row    -> its cache slot (the trained device value)
+  known cold row  -> slot -1 + the host-tier value in the overlay
+  unknown id      -> slot -1 + zeros (a never-trained id serves the
+                     model's bias path, not garbage)
+
+Serving NEVER grows the vocabulary or mutates the cache — Predict is
+read-only by contract (a growth on the serve path would diverge
+replicas from the trainer's deterministic id->row map).
+
+Hot swap: the reloader calls `swap(variables, step, ...)` exactly as it
+does on a plain engine (`step`/`state_template` delegate); the wrapper
+additionally loads the step's sidecar so tier metadata (vocab, cache
+map, host planes) swaps atomically WITH the device variables.  An RLock
+spans translate+predict and swap, so a request always sees one
+consistent (metadata, variables) generation — in-flight requests finish
+on the generation they read, zero dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.store import checkpoint as store_ckpt
+from elasticdl_tpu.store.host_tier import LazyVocabulary
+
+logger = get_logger(__name__)
+
+
+class TieredServingEngine:
+    """`engine` is a ServingEngine over the tiered model, compiled
+    against the translated feature spec ({dense, slots, <overlays>}).
+    `overlay_features` maps each store plane to the feature name its
+    cold values travel under (deepfm_tiered: fm_embedding -> cold_fm,
+    fm_linear -> cold_linear)."""
+
+    def __init__(self, engine, checkpoint_dir: str, step: int,
+                 overlay_features: Dict[str, str],
+                 slots_feature: str = "slots",
+                 sparse_feature: str = "sparse"):
+        self._engine = engine
+        self._dir = checkpoint_dir
+        self._overlay_features = dict(overlay_features)
+        self._slots_feature = slots_feature
+        self._sparse_feature = sparse_feature
+        self._lock = threading.RLock()
+        self._adopt_sidecar(int(step))
+
+    # ---- tier metadata -------------------------------------------------
+
+    def _adopt_sidecar(self, step: int) -> None:
+        if not store_ckpt.has_sidecar(self._dir, step):
+            raise RuntimeError(
+                f"checkpoint step {step} has no tiered sidecar under "
+                f"{self._dir}; cannot serve a tiered model without its "
+                "vocabulary/cache metadata"
+            )
+        sidecar = store_ckpt.load_sidecar(self._dir, step)
+        meta = sidecar.meta
+        vocab = LazyVocabulary.from_arrays(
+            int(meta["num_fields"]), *sidecar.vocab_arrays()
+        )
+        n = vocab.size
+        # store row -> cache slot (-1 when not resident)
+        slot_of_row = np.full(max(n, 1), -1, np.int64)
+        resident = (sidecar.row_of >= 0) & (sidecar.row_of < n)
+        slot_of_row[sidecar.row_of[resident]] = np.nonzero(resident)[0]
+        host_planes = {
+            name: sidecar.host_plane(name) for name in meta["planes"]
+        }
+        with self._lock:
+            self._vocab = vocab
+            self._slot_of_row = slot_of_row
+            self._host_planes = host_planes
+            self._planes = {
+                name: int(dim) for name, dim in meta["planes"].items()
+            }
+
+    # ---- engine delegation (reloader compatibility) --------------------
+
+    @property
+    def step(self) -> int:
+        return self._engine.step
+
+    @property
+    def state_template(self):
+        return self._engine.state_template
+
+    @property
+    def produced_unix_s(self) -> Optional[float]:
+        return self._engine.produced_unix_s
+
+    @property
+    def swap_count(self) -> int:
+        return self._engine.swap_count
+
+    @property
+    def compile_count(self) -> int:
+        return self._engine.compile_count
+
+    def swap(self, variables, step: int,
+             produced_unix_s: Optional[float] = None) -> None:
+        """Adopt the step's tier metadata, then swap the device
+        variables — one atomic generation change under the lock.  Raises
+        (leaving the CURRENT generation serving) when the sidecar is
+        missing: the reloader counts that as a rejected step."""
+        with self._lock:
+            self._adopt_sidecar(int(step))
+            self._engine.swap(variables, step,
+                              produced_unix_s=produced_unix_s)
+            vocab_rows = int(self._vocab.size)
+        events.emit(events.STORE_TIER_SWAPPED, step=int(step),
+                    vocab_rows=vocab_rows)
+
+    # ---- predict -------------------------------------------------------
+
+    def translate(self, sparse: np.ndarray) -> Tuple[np.ndarray, Dict]:
+        """(slots, overlay features) for a raw (B, F) id batch.  Callers
+        holding no lock get a consistent snapshot because the method
+        grabs the generation lock itself."""
+        with self._lock:
+            rows = self._vocab.lookup(np.asarray(sparse, np.int64))
+            slots = np.full(rows.shape, -1, np.int32)
+            known = rows >= 0
+            slots[known] = self._slot_of_row[rows[known]]
+            cold = known & (slots < 0)
+            overlays = {}
+            for plane, feat in self._overlay_features.items():
+                dim = self._planes[plane]
+                overlay = np.zeros(rows.shape + (dim,), np.float32)
+                if cold.any():
+                    overlay[cold] = self._host_planes[plane][rows[cold]]
+                overlays[feat] = overlay
+            return slots, overlays
+
+    def predict(self, features: Dict[str, np.ndarray], rows: int,
+                phase_out: Optional[Dict[str, float]] = None):
+        """Raw `{dense, sparse}` features in; (predictions, step) out.
+        Held under the generation lock end-to-end so the slots/overlays
+        and the device variables always belong to the same checkpoint."""
+        with self._lock:
+            translated = {
+                k: v for k, v in features.items()
+                if k != self._sparse_feature
+            }
+            slots, overlays = self.translate(
+                features[self._sparse_feature]
+            )
+            translated[self._slots_feature] = slots
+            translated.update(overlays)
+            return self._engine.predict(
+                translated, rows, phase_out=phase_out
+            )

@@ -1,0 +1,354 @@
+"""TieredStore: the orchestrator tying host tier, hot-row cache, and
+the device seam together.
+
+Data flow per training batch (single producer, single consumer):
+
+  prefetch producer thread (wrap_feed/wrap_feed_bulk):
+      prepare(sparse) -> (slots, CachePlan)
+        - lazy vocab growth (host tier assign)
+        - cache admission plan (frequency-ranked, deterministic)
+        - enqueue async host-gather of admit-row values
+
+  cold-miss prefetcher thread:
+      gathers admit values from the host tier -> plan.ready
+
+  consumer thread (trainer.train_on_batch, just before the step):
+      apply_plan(state, plan) -> state'
+        - read evicted rows from device, enqueue host fold
+        - wait for prefetched admit values (deferred rows: flush the
+          fold queue, then gather synchronously)
+        - scatter admits into the cache param + zero their moments
+
+  host-fold worker thread:
+      set_rows(evicted values) into the host tier
+
+Ordering invariant: prepare() runs strictly in batch order on the ONE
+producer thread, and apply_plan()/train run strictly in batch order on
+the consumer — so plan k+1's bookkeeping always reflects plan k's
+admissions, and eviction write-backs always carry the latest trained
+value.  Multi-worker training would break this (two producers would
+interleave prepare calls), which is why client/api.py rejects tiered
+specs with num_workers != 1.
+
+The stale-value hazard — a row evicted by plan k and re-admitted by
+plan k+j while its fold is still queued — is handled by the
+`_pending_writeback` set: such admits are marked `deferred`, and
+apply_plan flushes the fold queue before gathering them.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import events
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.metrics import MetricsRegistry
+from elasticdl_tpu.store import device as store_device
+from elasticdl_tpu.store.cache import CachePlan, HotRowCache
+from elasticdl_tpu.store.host_tier import HostTier
+
+logger = get_logger(__name__)
+
+
+class TieredStore:
+    """One store instance manages every embedding plane of one model
+    (DeepFM: fm_embedding + fm_linear), sharing one vocabulary and one
+    cache slot numbering across planes."""
+
+    def __init__(self, planes: Dict[str, int], num_fields: int,
+                 cache_rows: int, host_dtype: str = "fp32",
+                 seed: int = 0x5EED,
+                 param_paths: Optional[Dict[str, Tuple[str, ...]]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 phase_timer=None):
+        self.planes = dict(planes)
+        self.num_fields = int(num_fields)
+        self.cache_rows = int(cache_rows)
+        self.host = HostTier(planes, num_fields, host_dtype, seed)
+        self.cache = HotRowCache(cache_rows)
+        self.param_paths = dict(param_paths) if param_paths else {
+            name: ("params", name, "embedding") for name in planes
+        }
+        self.phase_timer = phase_timer
+        self.registry = registry if registry is not None else MetricsRegistry()
+
+        self._lock = threading.Lock()
+        self._pending_writeback = set()     # store rows with fold in flight
+        self._gather_q: "queue.Queue" = queue.Queue()
+        self._fold_q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = []
+        self._started = False
+        # Liveness counters the Local-path regression test asserts on.
+        self.prefetch_ticks = 0
+        self.fold_ticks = 0
+        # Cold-gather seconds split by where they ran: the prefetcher
+        # thread (overlapped with compute) vs the consumer at apply time
+        # (on the critical path).  The bench reports the overlap share.
+        self.gather_async_s = 0.0
+        self.gather_sync_s = 0.0
+
+        self._hits = self.registry.counter(
+            "store_cache_hits_total",
+            "Embedding lookups served by the device hot-row cache",
+        )
+        self._misses = self.registry.counter(
+            "store_cache_misses_total",
+            "Embedding lookups that needed a host-tier admission",
+        )
+        self._growth = self.registry.counter(
+            "store_growth_rows_total",
+            "Vocabulary rows lazily grown on first lookup",
+        )
+        self._gather_hist = self.registry.histogram(
+            "store_cold_gather_seconds",
+            "Host-tier gather latency for cold-row admissions",
+        )
+        self.registry.gauge_fn(
+            "store_cache_occupancy_rows",
+            lambda: float(self.cache.occupancy),
+            "Resident rows in the device hot-row cache",
+        )
+        self.registry.gauge_fn(
+            "store_cache_hit_ratio",
+            self._hit_ratio,
+            "Lifetime cache hit fraction of embedding lookups",
+        )
+
+    def _hit_ratio(self) -> float:
+        hits = self._hits.value()
+        total = hits + self._misses.value()
+        return (hits / total) if total else 0.0
+
+    # ---- background threads -------------------------------------------
+
+    def start(self) -> None:
+        """Start the cold-miss prefetcher and host-fold worker.  The
+        Local path must call this too (it never goes through
+        Master.start) — client/api.py owns that call."""
+        if self._started:
+            return
+        self._started = True
+        self._stop.clear()
+        for name, fn in (("store-prefetch", self._gather_loop),
+                         ("store-fold", self._fold_loop)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._fold_q.join()      # drain pending write-backs first
+        self._gather_q.put(None)
+        self._fold_q.put(None)
+        for t in self._threads:
+            t.join(timeout=30.0)
+        self._threads = []
+        self._started = False
+
+    def _gather_loop(self) -> None:
+        while True:
+            plan = self._gather_q.get()
+            if plan is None:
+                return
+            try:
+                t0 = time.perf_counter()
+                plan.admit_values = self.host.gather(plan.prefetch_rows)
+                dt = time.perf_counter() - t0
+                self._gather_hist.record(dt)
+                if self.phase_timer is not None:
+                    self.phase_timer.add("cold_gather", dt)
+                self.gather_async_s += dt
+                self.prefetch_ticks += 1
+            except Exception:
+                logger.exception("cold-row prefetch failed")
+            finally:
+                plan.ready.set()
+
+    def _fold_loop(self) -> None:
+        while True:
+            item = self._fold_q.get()
+            if item is None:
+                self._fold_q.task_done()
+                return
+            rows, values = item
+            try:
+                self.host.set_rows(rows, values)
+                with self._lock:
+                    for r in rows:
+                        self._pending_writeback.discard(int(r))
+                self.fold_ticks += 1
+            except Exception:
+                logger.exception("host fold failed")
+            finally:
+                self._fold_q.task_done()
+
+    # ---- producer side -------------------------------------------------
+
+    def prepare(self, sparse: np.ndarray):
+        """Producer-side planning: grow vocab, plan cache admissions,
+        kick off the async host gather.  Returns (slots, plan).  MUST be
+        called in batch order from a single thread."""
+        with self._lock:
+            rows, n_new = self.host.assign(sparse)
+            plan = self.cache.plan(rows)
+            plan.growth = n_new
+            for r in plan.evict_rows:
+                self._pending_writeback.add(int(r))
+            plan.deferred = np.fromiter(
+                (int(r) in self._pending_writeback
+                 for r in plan.admit_rows),
+                bool, plan.admit_rows.size,
+            )
+            plan.prefetch_rows = plan.admit_rows[~plan.deferred]
+        self._hits.inc(plan.hits)
+        self._misses.inc(plan.misses)
+        if n_new:
+            self._growth.inc(n_new)
+            events.emit(events.STORE_GROWN, rows=n_new,
+                        vocab_rows=self.host.size)
+        if plan.prefetch_rows.size and self._started:
+            self._gather_q.put(plan)
+        else:
+            # Nothing to prefetch (or threads not running: tests drive
+            # apply_plan synchronously) — gather happens at apply time.
+            plan.ready.set()
+        return plan.slots, plan
+
+    # ---- consumer side -------------------------------------------------
+
+    def apply_plan(self, state, plan: CachePlan):
+        """Consumer-side execution, strictly before the train step that
+        consumes `plan.slots`.  Returns the updated state."""
+        if plan.evict_rows.size:
+            evicted = store_device.read_rows(
+                state, self.param_paths, plan.evict_slots
+            )
+            self._fold_q.put((plan.evict_rows.copy(), evicted))
+            if not self._started:
+                self._drain_fold_queue_inline()
+        if plan.admit_rows.size:
+            plan.ready.wait()
+            values = plan.admit_values
+            missing = (
+                plan.deferred
+                if values
+                else np.ones(plan.admit_rows.size, bool)
+            )
+            if missing.any():
+                # Deferred rows: their latest value is on the fold queue
+                # — flush it, then gather synchronously (attributed to
+                # cold_gather on the consumer, i.e. NOT overlapped).
+                t0 = time.perf_counter()
+                self._fold_q.join()
+                cold = self.host.gather(plan.admit_rows[missing])
+                dt = time.perf_counter() - t0
+                self._gather_hist.record(dt)
+                if self.phase_timer is not None:
+                    self.phase_timer.add("cold_gather", dt)
+                self.gather_sync_s += dt
+                full = {}
+                for name, dim in self.planes.items():
+                    arr = np.empty(
+                        (plan.admit_rows.size, dim), np.float32
+                    )
+                    if values:
+                        arr[~missing] = values[name]
+                    arr[missing] = cold[name]
+                    full[name] = arr
+                values = full
+            state = store_device.apply_admissions(
+                state, self.param_paths, plan.admit_slots, values
+            )
+        return state
+
+    def _drain_fold_queue_inline(self) -> None:
+        """Synchronous fold for thread-less (unit-test) operation."""
+        while True:
+            try:
+                item = self._fold_q.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                self._fold_q.task_done()
+                continue
+            rows, values = item
+            try:
+                self.host.set_rows(rows, values)
+                with self._lock:
+                    for r in rows:
+                        self._pending_writeback.discard(int(r))
+                self.fold_ticks += 1
+            finally:
+                self._fold_q.task_done()
+
+    # ---- feed integration ---------------------------------------------
+
+    def attach(self, batch: dict) -> dict:
+        """Rewrite one feed batch: raw `sparse` ids become cache `slots`,
+        and the plan rides along under `__store_plan__` (popped by the
+        trainer before any tree_map sees the batch)."""
+        features = dict(batch["features"])
+        sparse = features.pop("sparse")
+        slots, plan = self.prepare(sparse)
+        features["slots"] = slots
+        out = dict(batch)
+        out["features"] = features
+        out["__store_plan__"] = plan
+        return out
+
+    def wrap_feed(self, feed):
+        """Wrap a feed/feed_bulk callable so every batch it produces is
+        store-prepared.  Runs on the prefetch producer thread — the ONE
+        sequential prepare() site."""
+        if feed is None:
+            return None
+
+        def wrapped(*args, **kwargs):
+            return self.attach(feed(*args, **kwargs))
+
+        return wrapped
+
+    # ---- checkpoint integration ---------------------------------------
+
+    def load_sidecar_state(self, host_state: Dict[str, np.ndarray],
+                           row_of: np.ndarray,
+                           score: Optional[np.ndarray] = None) -> None:
+        """Adopt a restored sidecar: host planes + vocab + cache map.
+        Cache VALUES live in the restored TrainState (orbax), so only
+        bookkeeping changes here."""
+        with self._lock:
+            self.host.load_state_dict(host_state)
+            self.cache.load_state_arrays(row_of, score)
+            self._pending_writeback.clear()
+
+    # ---- introspection -------------------------------------------------
+
+    def stats(self) -> dict:
+        hits = self._hits.value()
+        misses = self._misses.value()
+        total = hits + misses
+        return {
+            "hit_rate": (hits / total) if total else 0.0,
+            "hits": int(hits),
+            "misses": int(misses),
+            "growth_rows": int(self._growth.value()),
+            "vocab_rows": self.host.size,
+            "cache_occupancy_rows": self.cache.occupancy,
+            "cache_rows": self.cache_rows,
+            "host_bytes": self.host.nbytes,
+            "prefetch_ticks": self.prefetch_ticks,
+            "fold_ticks": self.fold_ticks,
+            "cold_gather_async_s": self.gather_async_s,
+            "cold_gather_sync_s": self.gather_sync_s,
+            "cold_gather_overlap_share": (
+                self.gather_async_s
+                / max(self.gather_async_s + self.gather_sync_s, 1e-12)
+            ),
+        }

@@ -57,7 +57,7 @@ _phase_timer = profiler_lib.PhaseTimer(
     histogram=metrics_lib.default_registry().histogram(
         "worker_step_phase_seconds",
         "per-step wall time attributed to a phase "
-        "(data_wait/pack/h2d_stage/compute/report)",
+        "(profiler.STEP_PHASES)",
         labelnames=("phase",),
     )
 )

@@ -105,6 +105,12 @@ class Trainer:
     # to compute is deliberate: it IS time the step spent not overlapped).
     phase_timer = None
 
+    # Tiered embedding store (elasticdl_tpu/store).  When set, batches
+    # carry a `__store_plan__` admission plan the trainer must execute
+    # against the state BEFORE the step that consumes the batch's slots.
+    # Class default so __new__-built trainers (tests) stay flat.
+    tiered_store = None
+
     def _timed(self, phase_name: str, fn, *args):
         timer = self.phase_timer
         if timer is None:
@@ -369,13 +375,32 @@ class Trainer:
         CPU backend the transfer rides inside the serialized region
         (_CPU_EXEC_LOCK), on TPU it's a plain async enqueue."""
         mesh_lib.set_current_mesh(self.mesh)
-        return self._timed(
+        # A store admission plan is host bookkeeping, not batch data —
+        # pop it around the shard (tree_map would treat it as a leaf and
+        # try to device_put it), reattach on a copy after.
+        plan = batch.get("__store_plan__")
+        if plan is not None:
+            batch = {k: v for k, v in batch.items() if k != "__store_plan__"}
+        staged = self._timed(
             "h2d_stage", run_device_serialized,
             mesh_lib.shard_batch, batch, self.mesh,
         )
+        if plan is not None:
+            staged = dict(staged)
+            staged["__store_plan__"] = plan
+        return staged
 
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
         mesh_lib.set_current_mesh(self.mesh)  # for mesh-aware model code
+
+        # Tiered store: execute the batch's admission plan first — every
+        # slot the step is about to gather must be cache-resident, and
+        # evicted rows must be read out before their slots are reused.
+        plan = batch.get("__store_plan__")
+        if plan is not None:
+            batch = {k: v for k, v in batch.items() if k != "__store_plan__"}
+            if self.tiered_store is not None:
+                state = self.tiered_store.apply_plan(state, plan)
 
         # The batch transfer rides inside the serialized region: a
         # device_put racing another thread's step execution corrupts the

@@ -53,10 +53,15 @@ _tasks_counter = metrics_lib.default_registry().counter(
 _phase_hist = metrics_lib.default_registry().histogram(
     "worker_step_phase_seconds",
     "per-step wall time attributed to a phase "
-    "(data_wait/pack/h2d_stage/compute/report)",
+    "(profiler.STEP_PHASES)",
     labelnames=("phase",),
 )
 _phase_timer = profiler_lib.PhaseTimer(histogram=_phase_hist)
+# Zero-initialize every catalogued phase so /metrics always exposes the
+# full vocabulary — phases a given run never exercises (cold_gather is
+# tiered-store-only) render with count 0 instead of disappearing.
+for _p in profiler_lib.STEP_PHASES:
+    _phase_hist.labels(phase=_p)
 
 
 def _same_batch_shapes(a, b) -> bool:

@@ -119,6 +119,38 @@ def arena_field_lookup(arena, field_ids, prehashed):
     return arena({"sparse": field_ids})["sparse"]
 
 
+def deepfm_tail(emb, first, dense, mlp_dims, compute_dtype):
+    """Everything after the embedding lookups: FM reductions, wide head,
+    deep tower.  A plain function called from inside an `@nn.compact`
+    __call__ (flax resolves the Dense submodules against the CALLING
+    module), shared by `DeepFM` and the tiered variant
+    (model_zoo/deepfm/deepfm_tiered.py) so the two stay numerically
+    identical layer-for-layer — same names, hence the SAME path-based
+    init — and the tiered parity bench can compare them exactly."""
+    # FM second order: 0.5 * sum_k [ (sum_f v)^2 - sum_f v^2 ]
+    sum_f = jnp.sum(emb, axis=1)
+    fm2 = 0.5 * jnp.sum(
+        sum_f * sum_f - jnp.sum(emb * emb, axis=1), axis=-1
+    )
+
+    dense_n = normalize_dense(dense)                   # (B, 13)
+    wide = nn.Dense(1, name="dense_linear")(dense_n)[..., 0]
+
+    deep_in = jnp.concatenate(
+        [dense_n, emb.reshape(emb.shape[0], -1)], axis=-1
+    )
+    h = deep_in.astype(compute_dtype)
+    for i, width in enumerate(mlp_dims):
+        h = nn.relu(
+            nn.Dense(width, name=f"mlp_{i}", dtype=compute_dtype)(h)
+        )
+    deep = nn.Dense(1, name="mlp_out", dtype=compute_dtype)(h)[
+        ..., 0
+    ].astype(jnp.float32)
+
+    return wide + jnp.sum(first[..., 0], axis=1) + fm2 + deep  # logits
+
+
 class DeepFM(nn.Module):
     vocab_capacity: int = 1 << 18  # shared table rows (hash space)
     embed_dim: int = 16
@@ -152,28 +184,10 @@ class DeepFM(nn.Module):
             arena_dtype=self.arena_dtype,
         ), field_ids, prehashed)
 
-        # FM second order: 0.5 * sum_k [ (sum_f v)^2 - sum_f v^2 ]
-        sum_f = jnp.sum(emb, axis=1)
-        fm2 = 0.5 * jnp.sum(sum_f * sum_f - jnp.sum(emb * emb, axis=1), axis=-1)
-
-        dense_n = normalize_dense(features["dense"])       # (B, 13)
-        wide = nn.Dense(1, name="dense_linear")(dense_n)[..., 0]
-
-        deep_in = jnp.concatenate(
-            [dense_n, emb.reshape(emb.shape[0], -1)], axis=-1
+        return deepfm_tail(
+            emb, first, features["dense"], self.mlp_dims,
+            self.compute_dtype,
         )
-        h = deep_in.astype(self.compute_dtype)
-        for i, width in enumerate(self.mlp_dims):
-            h = nn.relu(
-                nn.Dense(
-                    width, name=f"mlp_{i}", dtype=self.compute_dtype
-                )(h)
-            )
-        deep = nn.Dense(1, name="mlp_out", dtype=self.compute_dtype)(h)[
-            ..., 0
-        ].astype(jnp.float32)
-
-        return wide + jnp.sum(first[..., 0], axis=1) + fm2 + deep  # logits
 
 
 def custom_model(

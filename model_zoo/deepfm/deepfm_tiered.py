@@ -1,0 +1,118 @@
+"""DeepFM over the tiered embedding store (elasticdl_tpu/store).
+
+Identical to model_zoo/deepfm/deepfm_functional_api.py EXCEPT the
+embedding storage: instead of two full-vocabulary `EmbeddingArena`
+tables in HBM, the model holds two `TieredArena` hot-row caches and the
+full (lazily grown) vocabulary lives in the store's host-RAM tier.
+Everything after the lookups is the literal same code (`deepfm_tail`),
+so the two variants initialise identically (flax path-based RNG over
+identical Dense names) and the parity bench can compare them exactly.
+
+Features arrive pre-translated by the store:
+  slots        (B, 26) int32 cache slots (TieredStore.prepare)
+  cold_fm      (B, 26, embed_dim) serving-only overlay for cold rows
+  cold_linear  (B, 26, 1)         serving-only overlay for cold rows
+
+Training never passes overlays (every row is admitted before the step);
+serving passes them for slot == -1 positions (store/serving.py).
+
+The Local runner (client/api.py) detects `build_tiered_store` on this
+module, wraps the feeds with the store's id->slot translation, and
+starts the store's background threads.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elasticdl_tpu.layers.arena import TieredArena
+from elasticdl_tpu.store.tiered import TieredStore
+from model_zoo.deepfm.deepfm_functional_api import (  # noqa: F401
+    NUM_DENSE,
+    NUM_SPARSE,
+    deepfm_tail,
+    eval_metrics_fn,
+    feed,
+    feed_bulk,
+    loss,
+    optimizer,
+)
+
+# Set by custom_model(); read by build_tiered_store().  The feeds get no
+# model handle, so the store must be built from the same configuration
+# the model in this process was built with (same pattern as
+# DEDUP_VOCAB_CAPACITY in deepfm_functional_api).
+CACHE_ROWS = 1 << 12
+EMBED_DIM = 16
+HOST_DTYPE = "fp32"
+STORE_SEED = 0x5EED
+
+# The store the Local runner built last — regression tests reach in here
+# to assert its background threads actually ticked.
+_LAST_STORE = None
+
+
+class TieredDeepFM(nn.Module):
+    cache_rows: int = 1 << 12
+    embed_dim: int = 16
+    mlp_dims: tuple = (256, 128)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, features):
+        slots = features["slots"]
+        # second-order / deep embeddings: (B, 26, k)
+        emb = TieredArena(
+            self.cache_rows, self.embed_dim, name="fm_embedding"
+        )(slots, overlay=features.get("cold_fm"))
+        # first-order weights: (B, 26, 1)
+        first = TieredArena(
+            self.cache_rows, 1, name="fm_linear"
+        )(slots, overlay=features.get("cold_linear"))
+        return deepfm_tail(
+            emb, first, features["dense"], self.mlp_dims,
+            self.compute_dtype,
+        )
+
+
+def custom_model(
+    cache_rows: int = 1 << 12, embed_dim: int = 16, bf16: bool = False,
+    host_dtype: str = "fp32", store_seed: int = 0x5EED,
+):
+    global CACHE_ROWS, EMBED_DIM, HOST_DTYPE, STORE_SEED
+    CACHE_ROWS = int(cache_rows)
+    EMBED_DIM = int(embed_dim)
+    HOST_DTYPE = host_dtype
+    STORE_SEED = int(store_seed)
+    return TieredDeepFM(
+        cache_rows=CACHE_ROWS,
+        embed_dim=EMBED_DIM,
+        compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+    )
+
+
+def store_planes(embed_dim: int = None):
+    """plane name -> dim, matching TieredDeepFM's two arenas."""
+    return {
+        "fm_embedding": int(embed_dim or EMBED_DIM),
+        "fm_linear": 1,
+    }
+
+
+def build_tiered_store(registry=None, phase_timer=None) -> TieredStore:
+    """Store matching the last custom_model() configuration.  The Local
+    runner calls this once per job; the instance is also published as
+    `_LAST_STORE` for tests."""
+    global _LAST_STORE
+    store = TieredStore(
+        planes=store_planes(),
+        num_fields=NUM_SPARSE,
+        cache_rows=CACHE_ROWS,
+        host_dtype=HOST_DTYPE,
+        seed=STORE_SEED,
+        registry=registry,
+        phase_timer=phase_timer,
+    )
+    _LAST_STORE = store
+    return store

@@ -16,11 +16,20 @@ Trainer.stage_batch).  Two reasons:
   the training step's own dispatches and serializes the pipeline at the
   worst point (mid-parse) instead of overlapping with compute.
 
-In the host-plane files (elasticdl_tpu/data/** and
-worker/task_data_service.py) any use of the jax data-movement / device
-APIs below is an error.  jax.numpy math is NOT flagged — device-side
-unpack helpers (data/wire.py) are traced from the consumer's jitted
-step and never move data themselves.
+In the host-plane files (elasticdl_tpu/data/**, elasticdl_tpu/store/**,
+and worker/task_data_service.py) any use of the jax data-movement /
+device APIs below is an error.  jax.numpy math is NOT flagged —
+device-side unpack helpers (data/wire.py) are traced from the
+consumer's jitted step and never move data themselves.
+
+The tiered embedding store (elasticdl_tpu/store/) extends the contract:
+its host tier, cache bookkeeping, and orchestration are the ONE
+sanctioned home for host-side embedding row math — and precisely
+because they run on producer/worker threads, device APIs there are
+findings too.  The single exception is the staging seam
+`elasticdl_tpu/store/device.py` (allowlisted at registration below):
+every store device interaction funnels through it, and it routes all
+work through run_device_serialized.
 """
 
 from __future__ import annotations
@@ -44,7 +53,7 @@ FORBIDDEN_JAX_ATTRS = {
 # method form: any `x.block_until_ready()` implies x is a device array
 FORBIDDEN_METHODS = {"block_until_ready"}
 
-HOST_PLANE_PREFIXES = ("elasticdl_tpu/data/",)
+HOST_PLANE_PREFIXES = ("elasticdl_tpu/data/", "elasticdl_tpu/store/")
 HOST_PLANE_FILES = frozenset({
     "elasticdl_tpu/worker/task_data_service.py",
 })
@@ -114,4 +123,9 @@ class BoundaryRule(Rule):
             yield Finding(pf.rel, lineno, self.id, message)
 
 
-register(BoundaryRule())
+# store/device.py is the tiered store's sanctioned staging seam: the one
+# module where the store may touch device APIs (all routed through
+# run_device_serialized).  Everything else under store/ stays host-plane.
+register(BoundaryRule(allowlist=frozenset({
+    "elasticdl_tpu/store/device.py",
+})))

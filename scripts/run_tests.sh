@@ -3,10 +3,12 @@
 # no device), then unit + in-process integration tests on a virtual
 # 8-device CPU mesh, then the native-component build.
 #
-# Always ends with one machine-readable line:
+# Always ends with two machine-readable lines:
+#   STORE_SUMMARY hit_rate=<r> growth_rows=<n>
 #   TIER1_SUMMARY passed=<N> wall_s=<S> lint_findings=<L> status=<ok|fail>
-# so CI (and the roadmap driver) can scrape the tier-1 outcome without
-# parsing pytest's human output.
+# so CI (and the roadmap driver) can scrape the tier-1 outcome — and the
+# tiered store's cache efficacy (docs/PERF.md "Tiered embedding store")
+# — without parsing pytest's human output.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -62,5 +64,9 @@ path = recorder.capture("tier1_failure", evidence={
 print(f"tier1 incident bundle: {path}")
 EOF
 fi
+# Tiered-store cache efficacy over the canonical zipfian stream (pure
+# numpy, sub-second); failure is non-fatal here — the matching unit
+# test in tests/test_tiered_store.py owns the hard floor.
+python -m scripts.store_summary || true
 echo "TIER1_SUMMARY passed=${passed} wall_s=${wall_s} lint_findings=${lint_findings} status=${status}"
 exit "$rc"

@@ -1,0 +1,83 @@
+"""Tiered-store efficacy summary for CI.
+
+Runs the store's pure-numpy host side — LazyVocabulary growth +
+HotRowCache admission — over a deterministic zipfian id stream and
+prints one machine-readable line:
+
+    STORE_SUMMARY hit_rate=<r> growth_rows=<n>
+
+`scripts/run_tests.sh` emits it next to TIER1_SUMMARY so CI can watch
+cache efficacy drift without running the full bench
+(`python bench.py tiered`).  No jax, no devices: the whole check is
+host math, which is the point — a cache-policy regression shows up
+here in well under a second.
+
+tests/test_tiered_store.py asserts on `zipfian_summary()` directly, so
+the printed numbers and the tested numbers cannot diverge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Deliberately mirrors the bench's zipfian config (bench.py
+# bench_tiered): a skewed stream where a 4k-row cache over a ~8k-row
+# working vocabulary should hold the hot head (hit rate >= 0.9).
+NUM_FIELDS = 26
+BATCH = 128
+STEPS = 60
+CACHE_ROWS = 4096
+IDS_PER_FIELD = 2000
+ZIPF_A = 1.6
+SEED = 0x5EED
+
+
+def zipfian_batches(
+    steps: int = STEPS,
+    batch: int = BATCH,
+    num_fields: int = NUM_FIELDS,
+    ids_per_field: int = IDS_PER_FIELD,
+    a: float = ZIPF_A,
+    seed: int = SEED,
+):
+    """Deterministic (steps, batch, fields) zipfian id stream.  Rank r
+    is drawn with probability ∝ 1/r^a, then permuted per field so hot
+    ids differ across fields."""
+    rng = np.random.default_rng(seed)
+    ranks = np.minimum(
+        rng.zipf(a, size=(steps, batch, num_fields)), ids_per_field
+    ) - 1
+    perms = np.stack(
+        [rng.permutation(ids_per_field) for _ in range(num_fields)]
+    )
+    fields = np.arange(num_fields)[None, None, :]
+    return perms[fields, ranks].astype(np.int64)
+
+
+def zipfian_summary(cache_rows: int = CACHE_ROWS, **stream_kw):
+    """(hit_rate, growth_rows) of the host-side store over the zipfian
+    stream — the shared compute behind STORE_SUMMARY and the unit test."""
+    from elasticdl_tpu.store.cache import HotRowCache
+    from elasticdl_tpu.store.host_tier import LazyVocabulary
+
+    stream = zipfian_batches(**stream_kw)
+    vocab = LazyVocabulary(num_fields=stream.shape[2])
+    cache = HotRowCache(cache_rows)
+    hits = misses = 0
+    for sparse in stream:
+        rows, _, _, _ = vocab.assign(sparse)
+        plan = cache.plan(rows)
+        hits += plan.hits
+        misses += plan.misses
+    return hits / max(hits + misses, 1), vocab.size
+
+
+def main() -> int:
+    hit_rate, growth_rows = zipfian_summary()
+    print(f"STORE_SUMMARY hit_rate={hit_rate:.4f} "
+          f"growth_rows={growth_rows}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

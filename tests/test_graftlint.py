@@ -159,6 +159,26 @@ def test_boundary_allowlisted_file():
     assert not check_source(DEVICE_PUT, "elasticdl_tpu/data/x.py", [rule])
 
 
+def test_boundary_covers_store_package():
+    # the tiered store's host tier runs on producer/worker threads, so
+    # device APIs there are findings exactly like the data plane
+    src = "import jax\nrows = jax.device_get(table)\n"
+    found = check_source(src, "elasticdl_tpu/store/host_tier.py",
+                         [rules_boundary.BoundaryRule()])
+    assert _ids(found) == ["GL-BOUNDARY"]
+
+
+def test_boundary_store_staging_seam_allowlisted():
+    # store/device.py is the one sanctioned seam (registration allowlist)
+    src = "import jax\nrows = jax.device_get(table)\n"
+    rule = rules_boundary.BoundaryRule(
+        allowlist=frozenset({"elasticdl_tpu/store/device.py"})
+    )
+    assert not check_source(src, "elasticdl_tpu/store/device.py", [rule])
+    # but the same source anywhere else under store/ still fires
+    assert check_source(src, "elasticdl_tpu/store/tiered.py", [rule])
+
+
 # ---- GL-METRIC ----------------------------------------------------------
 
 

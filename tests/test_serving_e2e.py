@@ -101,10 +101,18 @@ def test_mixed_concurrent_traffic_with_midstream_hot_swap(stack):
     request succeeds, no bucket recompiles, and responses attribute
     their model step."""
     results, lock = [], threading.Lock()
+    # Clients send at least 12 requests each, then KEEP sending until
+    # someone observes the post-swap generation (bounded by a deadline):
+    # on a loaded box the save + reloader poll can land after 72 quick
+    # requests would have drained, which starved the mid-swap assertion.
+    saw_swap = threading.Event()
+    deadline = time.monotonic() + 20.0
 
     def client(seed):
         rng = np.random.RandomState(seed)
-        for _ in range(12):
+        sent = 0
+        while True:
+            sent += 1
             rows = int(rng.choice([1, 2, 3, 5, 8]))
             x = rng.rand(rows, 784).astype(np.float32)
             resp = stack.stub.predict(make_predict_request(x))
@@ -114,6 +122,11 @@ def test_mixed_concurrent_traffic_with_midstream_hot_swap(stack):
             )
             with lock:
                 results.append((resp.code, resp.model_step, rows, preds))
+            if resp.code == spb.SERVING_OK and resp.model_step == 2:
+                saw_swap.set()
+            if sent >= 12 and (saw_swap.is_set()
+                               or time.monotonic() > deadline):
+                return
 
     threads = [
         threading.Thread(target=client, args=(i,)) for i in range(6)
@@ -155,6 +168,12 @@ def test_corrupt_checkpoint_rejected_serving_continues(stack):
     is never retried."""
     served_before = stack.engine.step
     rejected_before = stack.reloader.rejected_count
+    # Hold the poll loop off step 3 until the bit-flip has landed: the
+    # reloader's never-retry set doubles as a gate, otherwise a poll
+    # between save and corruption adopts the still-intact step and the
+    # rejection never happens (a 50ms poll vs a few-ms corruption
+    # window — loses under load).
+    stack.reloader._rejected_steps.add(3)
     stack.save_step(3, scale=3.0)
     victim = None
     step_dir = os.path.join(stack.ckpt_dir, "3")
@@ -170,6 +189,7 @@ def test_corrupt_checkpoint_rejected_serving_continues(stack):
     with open(victim, "r+b") as f:
         f.seek(40)
         f.write(b"\xde\xad\xbe\xef")
+    stack.reloader._rejected_steps.discard(3)  # release the gate
     assert stack.wait_for(
         lambda: stack.reloader.rejected_count > rejected_before
     )
