@@ -1182,6 +1182,236 @@ def bench_serving_fleet(
     }
 
 
+def _online_chaos_run(seed: int):
+    """One seeded chaos pass of the online loop under a FAKE clock and a
+    strictly sequential driver: a stream stall (`stream.poll`), a lost
+    window re-arm (`task.rearm`), a rejected hot-reload
+    (`serving.reload`), and a mid-run replica kill all land mid-loop.
+    Returns (canonical_text, summary): the text concatenates the fault
+    trace, the fleet manager's and SLO evaluator's clock-free decision
+    lists, and the normalized span-event stream — byte-identical across
+    same-seed runs (the acceptance bar of docs/ONLINE.md)."""
+    import tempfile
+
+    from elasticdl_tpu.common import events as events_lib
+    from elasticdl_tpu.common import faults
+    from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+    from elasticdl_tpu.proto import serving_pb2 as spb
+    from elasticdl_tpu.serving.server import make_predict_request
+    from model_zoo.clickstream import ctr_mlp
+
+    clk = [1_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    # Explicit (still seed-stamped) schedule: every fault is one the
+    # driver is guaranteed to reach, so `all_fired()` holds and the
+    # trace compares byte-for-byte (the chaos-soak discipline).
+    registry = faults.install(FaultRegistry(
+        schedule=[
+            FaultSpec(faults.POINT_STREAM_POLL, 2, "raise"),
+            FaultSpec(faults.POINT_TASK_REARM, 3, "raise"),
+            FaultSpec(faults.POINT_SERVING_RELOAD, 2, "raise"),
+        ],
+        seed=seed,
+    ))
+    keep = ("window", "tasks", "records", "step")
+    norm_events = []
+
+    def observe(record):
+        norm_events.append({
+            "event": record.get("event"),
+            **{k: record[k] for k in keep if k in record},
+        })
+
+    events_lib.add_observer(observe)
+    rng = np.random.RandomState(seed)
+    failed = 0
+    try:
+        spec = get_model_spec(_ZOO, "clickstream.ctr_mlp.custom_model")
+        with tempfile.TemporaryDirectory() as tmp:
+            pipe = OnlinePipeline(
+                tmp, spec,
+                OnlineConfig(
+                    seed=seed, window_records=64, records_per_poll=64,
+                    records_per_task=16, checkpoint_every_windows=2,
+                    replicas=2,
+                ),
+                clock=clock,
+            )
+            for i in range(12):
+                pipe.tick()
+                if i == 3:
+                    pipe.kill_replica(1)
+                    faults.note("replica.kill", "replica=1")
+                for _ in range(2):
+                    x = ctr_mlp.encode(
+                        rng.randint(0, 512, 2), rng.randint(0, 128, 2)
+                    )
+                    try:
+                        resp = pipe.predict(make_predict_request(x))
+                        if resp.code != spb.SERVING_OK:
+                            failed += 1
+                    except Exception:
+                        failed += 1
+            snap = pipe.snapshot()
+            pipe.shutdown()
+    finally:
+        events_lib.remove_observer(observe)
+        faults.uninstall()
+
+    canonical = json.dumps({
+        "fault_trace": registry.trace_text(),
+        "fleet_decisions": snap["serving_fleet"]["decisions"],
+        "slo_decisions": snap["slo"]["decisions"],
+        "events": norm_events,
+    }, sort_keys=True)
+    summary = {
+        "all_faults_fired": registry.all_fired(),
+        "failed_requests": failed,
+        "rearm_faults": snap["online"]["rearm_faults"],
+        "poll_faults": snap["stream"]["poll_faults"],
+        "last_reload_step": snap["online"]["last_reload_step"],
+        "windows_trained": snap["windows_trained"],
+    }
+    return canonical, summary
+
+
+def bench_online(
+    windows: int = 8,
+    load_clients: int = 2,
+    chaos_seed: int = 20260805,
+):
+    """Online loop bench (`python bench.py --online`): the whole
+    continuous-learning pipeline — unbounded stream -> perpetual task
+    queue -> train -> checkpoint -> rolling hot-reload — sustained for
+    `windows` stream windows UNDER CONCURRENT PREDICT LOAD, then a
+    seeded chaos determinism check (docs/ONLINE.md).  Reports sustained
+    train examples/s (the headline), served QPS and client-observed p99
+    while the model keeps swapping underneath, train-to-serve staleness
+    p50/p99 in steps AND seconds (real produced->served lag on a real
+    clock), the max staleness-SLO burn rate, the number of
+    checkpoint->hot-reload cycles completed behind live traffic (must
+    be >= 2), and the failed-request count (must be 0).  The chaos
+    variant runs twice with the same seed under a fake clock — stream
+    stall + window re-arm loss + rejected reload + replica kill — and
+    asserts the fault trace / fleet decisions / SLO decisions / event
+    stream compare byte-identical."""
+    import tempfile
+    import threading
+    import time
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+    from elasticdl_tpu.proto import serving_pb2 as spb
+    from elasticdl_tpu.serving.server import make_predict_request
+    from model_zoo.clickstream import ctr_mlp
+
+    spec = get_model_spec(_ZOO, "clickstream.ctr_mlp.custom_model")
+    cfg = OnlineConfig(
+        window_records=64, records_per_poll=64, records_per_task=16,
+        checkpoint_every_windows=2, replicas=2,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        pipe = OnlinePipeline(tmp, spec, cfg)
+        stop = threading.Event()
+        latencies, failed = [], []
+        lock = threading.Lock()
+
+        def run_load(seed):
+            rng = np.random.RandomState(seed)
+            mine = []
+            while not stop.is_set():
+                n = (1, 2, 4)[rng.randint(3)]
+                x = ctr_mlp.encode(
+                    rng.randint(0, cfg.source_users, n),
+                    rng.randint(0, cfg.source_items, n),
+                )
+                t0 = time.perf_counter()
+                try:
+                    resp = pipe.predict(make_predict_request(x))
+                    ok = resp.code == spb.SERVING_OK
+                except Exception:
+                    ok = False
+                dt = time.perf_counter() - t0
+                if ok:
+                    mine.append(dt)
+                else:
+                    with lock:
+                        failed.append(seed)
+            with lock:
+                latencies.extend(mine)
+
+        threads = [
+            threading.Thread(target=run_load, args=(i,))
+            for i in range(load_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        ticks = 0
+        while pipe._windows_trained < windows and ticks < windows * 4:
+            pipe.tick()
+            ticks += 1
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        staleness = pipe.freshness.quantiles()
+        snap = pipe.snapshot()
+        pipe.shutdown()
+
+    trace_a, summary_a = _online_chaos_run(chaos_seed)
+    trace_b, summary_b = _online_chaos_run(chaos_seed)
+
+    lat_s = np.array(latencies) if latencies else np.array([0.0])
+    fleet = snap["serving_fleet"]
+    train_eps = snap["examples_trained"] / elapsed
+    return {
+        "bench": "online",
+        "value": round(train_eps, 1),
+        "unit": "train_examples_per_sec",
+        "detail": {
+            "model": "clickstream.ctr_mlp.custom_model",
+            "windows_trained": snap["windows_trained"],
+            "ticks": ticks,
+            "elapsed_s": round(elapsed, 3),
+            "train_examples_per_sec": round(train_eps, 1),
+            "served_qps": round(len(latencies) / elapsed, 1),
+            "requests": len(latencies) + len(failed),
+            "p50_ms": round(float(np.percentile(lat_s, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat_s, 99)) * 1e3, 3),
+            "failed_requests": len(failed),
+            # distinct checkpoint steps the fleet rolled onto replicas
+            # behind live traffic — the >= 2 cycles acceptance bar
+            "reload_cycles": len({
+                d["target_step"] for d in fleet["decisions"]
+                if d.get("action") == "reload_step"
+            }),
+            "replica_hot_swaps": fleet["reload_steps"],
+            "last_reload_step": snap["online"]["last_reload_step"],
+            "staleness_p50_steps": staleness["staleness_p50_steps"],
+            "staleness_p99_steps": staleness["staleness_p99_steps"],
+            "staleness_p50_s": staleness["staleness_p50_s"],
+            "staleness_p99_s": staleness["staleness_p99_s"],
+            "max_burn_rate": round(snap["max_burn"], 3),
+            "watermark_lag_s": snap["stream"]["watermark_lag_s"],
+            "dropped_windows": snap["stream"]["dropped_windows"],
+            "chaos": {
+                "seed": chaos_seed,
+                "deterministic": trace_a == trace_b,
+                **summary_a,
+                "failed_requests_run_b":
+                    summary_b["failed_requests"],
+            },
+        },
+    }
+
+
 def bench_sparse_path(batch_size: int = 65536):
     """Sparse-path economics (`python bench.py --sparse-path`):
 
@@ -1786,6 +2016,7 @@ def main():
               "serving": bench_serving,
               "serving-fleet": bench_serving_fleet,
               "serving_fleet": bench_serving_fleet,
+              "online": bench_online,
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
               "tiered": bench_tiered,

@@ -75,6 +75,15 @@ def render_slo(slo: dict) -> str:
                 cap=history.get("capacity", 0),
             )
         )
+        if "stream_lag_samples" in history:
+            # online (perpetual) jobs: the armed-watermark lag gauge is
+            # part of the evaluator's evidence — show its coverage
+            lines.append(
+                "  stream lag: {n} samples "
+                "(master_stream_watermark_lag_seconds)".format(
+                    n=history.get("stream_lag_samples", 0),
+                )
+            )
     return "\n".join(lines)
 
 

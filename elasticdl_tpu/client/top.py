@@ -83,6 +83,20 @@ def render(varz: dict, serving_varz: Optional[dict] = None,
             epochs=tasks.get("num_epochs", 0),
         )
     )
+    online = snapshot.get("online")
+    if online:
+        lines.append(
+            "online: window={win} lag={lag:.2f}s armed={armed} "
+            "tasks_rearmed={rearmed} rearm_faults={faults} "
+            "last_reload_step={reload}".format(
+                win=online.get("window", -1),
+                lag=online.get("watermark_lag_s", 0.0),
+                armed=online.get("windows_armed", 0),
+                rearmed=online.get("tasks_rearmed", 0),
+                faults=online.get("rearm_faults", 0),
+                reload=online.get("last_reload_step", "-"),
+            )
+        )
     pods = snapshot.get("pods")
     if pods:
         lines.append(

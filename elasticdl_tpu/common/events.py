@@ -52,6 +52,8 @@ PREDICT_SPAN = "predict_span"      # one routed serve request, all phases
 INCIDENT_CAPTURED = "incident_captured"  # flight recorder wrote a bundle
 STORE_GROWN = "store_grown"        # tiered store lazily grew vocab rows
 STORE_TIER_SWAPPED = "store_tier_swapped"  # serving adopted tier metadata
+STREAM_WINDOW_SEALED = "stream_window_sealed"  # a stream window filled
+STREAM_WINDOW_ARMED = "stream_window_armed"    # window became queue tasks
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -64,6 +66,7 @@ VOCABULARY = frozenset({
     POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
     FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED, PREDICT_SPAN,
     INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
+    STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every

@@ -53,6 +53,12 @@ POINT_POLICY_TICK = "policy.tick"
 POINT_RPC_HEALTH_PROBE = "rpc.health_probe"
 POINT_SERVING_REPLICA_KILL = "serving.replica_kill"
 POINT_FLEET_RELOAD_STEP = "fleet.reload_step"
+# Online continuous-learning boundaries (data/reader/stream_reader.py +
+# master/task_manager.py perpetual mode): a stream poll that stalls and
+# a window re-arm the queue never sees are the two ways fresh data stops
+# reaching training without anything crashing.
+POINT_STREAM_POLL = "stream.poll"
+POINT_TASK_REARM = "task.rearm"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -69,6 +75,8 @@ POINTS = (
     POINT_RPC_HEALTH_PROBE,
     POINT_SERVING_REPLICA_KILL,
     POINT_FLEET_RELOAD_STEP,
+    POINT_STREAM_POLL,
+    POINT_TASK_REARM,
 )
 
 ACTIONS = ("raise", "delay", "drop")

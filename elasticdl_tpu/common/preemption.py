@@ -164,7 +164,6 @@ class MaintenanceNoticeWatcher:
             except Exception:
                 notice = False
             if notice:
-                self._fired = True
                 logger.warning(
                     "Maintenance/preemption notice observed: draining at "
                     "the next task boundary and flushing checkpoint "
@@ -174,5 +173,8 @@ class MaintenanceNoticeWatcher:
                     self._on_notice()
                 except Exception as exc:
                     logger.error("Notice drain hook failed: %s", exc)
+                # published AFTER the drain hook: observers of `fired`
+                # may rely on the drain having actually happened
+                self._fired = True
                 return
             time.sleep(self._poll_s)

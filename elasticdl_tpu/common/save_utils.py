@@ -13,13 +13,58 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, FrozenSet, Optional
 
 from elasticdl_tpu.common import events, faults
 from elasticdl_tpu.common.log_utils import get_logger
 
 logger = get_logger(__name__)
+
+# ---- step pinning ---------------------------------------------------------
+#
+# The keep-last-K sweep and the serving hot-reload race: the trainer's
+# saver rotates old steps out while a reloader (its OWN CheckpointSaver
+# on the same directory) is mid-restore on one of them.  Orbax's
+# built-in max_to_keep cannot see the reloader, so rotation is owned
+# here instead (max_to_keep=None + an explicit sweep) and gated on a
+# PROCESS-WIDE pin registry keyed by the checkpoint directory: the
+# reloader pins the step for the duration of verify/restore/swap, and
+# the sweep skips pinned steps (they fall on the next sweep after
+# unpin).  Refcounted — overlapping pinners (N serving replicas
+# reloading the same step) each hold their own pin.
+
+_PIN_LOCK = threading.Lock()
+_PINNED: Dict[str, Dict[int, int]] = {}   # abs dir -> step -> refcount
+
+
+def pin_step(checkpoint_dir: str, step: int) -> None:
+    """Protect `step` from the keep-last-K sweep until unpinned."""
+    key = os.path.abspath(checkpoint_dir)
+    step = int(step)
+    with _PIN_LOCK:
+        dir_pins = _PINNED.setdefault(key, {})
+        dir_pins[step] = dir_pins.get(step, 0) + 1
+
+
+def unpin_step(checkpoint_dir: str, step: int) -> None:
+    key = os.path.abspath(checkpoint_dir)
+    step = int(step)
+    with _PIN_LOCK:
+        dir_pins = _PINNED.get(key)
+        if not dir_pins or step not in dir_pins:
+            return
+        dir_pins[step] -= 1
+        if dir_pins[step] <= 0:
+            del dir_pins[step]
+        if not dir_pins:
+            del _PINNED[key]
+
+
+def pinned_steps(checkpoint_dir: str) -> FrozenSet[int]:
+    with _PIN_LOCK:
+        return frozenset(_PINNED.get(os.path.abspath(checkpoint_dir), ()))
 
 
 def _file_digest(path: str) -> Dict[str, Any]:
@@ -218,10 +263,16 @@ class CheckpointSaver:
         self._manifest_dir = os.path.join(self._dir, ".manifests")
         os.makedirs(self._manifest_dir, exist_ok=True)
         self._async_save = bool(async_save)
+        # Rotation is owned HERE, not by orbax (max_to_keep=None): the
+        # sweep in _refresh_manifests keeps the newest `keep_max`
+        # finalized steps, prunes manifests and tiered sidecars in
+        # lockstep, and honors the pin registry above so a step a
+        # reloader is mid-swap on is never deleted under it.
+        self._keep_max = int(keep_max) if keep_max else None
         self._mngr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep_max,
+                max_to_keep=None,
                 enable_async_checkpointing=async_save,
             ),
         )
@@ -357,11 +408,35 @@ class CheckpointSaver:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._dir, str(step))
 
+    def _sweep_old_steps(self) -> None:
+        """Keep-last-K over FINALIZED steps: delete everything older than
+        the newest `keep_max`, except steps pinned by an in-flight
+        reloader swap (those rotate out on the first sweep after
+        unpin)."""
+        if self._keep_max is None:
+            return
+        steps = sorted(self._mngr.all_steps())
+        excess = steps[:-self._keep_max] if self._keep_max else steps
+        if not excess:
+            return
+        pinned = pinned_steps(self._dir)
+        for step in excess:
+            if step in pinned:
+                logger.info(
+                    "keep-last-%d sweep deferring step %d (pinned by an "
+                    "in-flight reload)", self._keep_max, step,
+                )
+                continue
+            self._mngr.delete(step)
+
     def _refresh_manifests(self) -> None:
-        """Write missing manifests for finalized steps and prune manifests
-        of rotated-away steps.  Best-effort: integrity metadata must never
-        fail a save."""
+        """Rotate old steps out (keep-last-K, pin-aware), then write
+        missing manifests for surviving finalized steps and prune
+        manifests + tiered sidecars of rotated-away steps — base dir and
+        `.tiered/<step>/` always move in lockstep.  Best-effort:
+        integrity metadata must never fail a save."""
         try:
+            self._sweep_old_steps()
             steps = set(self._mngr.all_steps())
             for step in steps:
                 path = self._manifest_path(step)

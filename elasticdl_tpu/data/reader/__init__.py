@@ -14,6 +14,10 @@ from typing import Dict, Type
 from elasticdl_tpu.data.reader.base import AbstractDataReader  # noqa: F401
 from elasticdl_tpu.data.reader.csv_reader import CSVDataReader  # noqa: F401
 from elasticdl_tpu.data.reader.memory_reader import MemoryDataReader  # noqa: F401
+from elasticdl_tpu.data.reader.stream_reader import (  # noqa: F401
+    ClickStreamSource,
+    StreamReader,
+)
 from elasticdl_tpu.data.reader.table_reader import (  # noqa: F401
     TableDataReader,
 )

@@ -1,0 +1,313 @@
+"""Unbounded stream reader: event-time records -> bounded windows.
+
+The batch readers in this package make a FINITE source shard-addressable
+(`create_shards()` enumerates it once).  A stream never ends, so the
+contract inverts: records arrive continuously with *event timestamps*,
+the reader buffers them into bounded windows of `window_records`, and
+each sealed window becomes shard-addressable exactly like one small
+epoch — `(window_name, 0, n)` — which the perpetual task manager
+(master/task_manager.py `arm_window`) turns into leaseable tasks.  The
+loop that ties polling, arming, training, checkpointing, and serving
+together lives in elasticdl_tpu/online/pipeline.py (docs/ONLINE.md).
+
+Time discipline:
+
+- The *clock* is injectable (policy.py/slo.py shape): event timestamps
+  and lag computations read `clock()`, so chaos tests drive the stream
+  with a fake clock and same-seed runs replay byte-identically.
+- The *watermark* is the newest event timestamp sealed into a window.
+  `watermark lag = clock() - watermark`: how far serving-visible
+  training trails the stream head.  A stalled poll (injected
+  `stream.poll` fault, docs/ROBUSTNESS.md) does not lose records — the
+  source re-delivers on the next poll — it shows up as lag.
+
+Backpressure: sealed windows wait in a bounded buffer
+(`max_buffered_windows`).  The pipeline releases each window after
+training it; if training falls so far behind that the buffer fills, the
+OLDEST window is dropped (counted — `data_stream_windows_dropped_total`
+should stay 0 in a healthy deployment) rather than growing host memory
+without bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.data.reader.base import AbstractDataReader
+
+logger = get_logger(__name__)
+
+
+class ClickStreamSource:
+    """Seeded synthetic click-stream: (user, item, clicked) impressions.
+
+    Record content is a pure function of (seed, record index) — the
+    clock only stamps `event_unix_s` — so two same-seed runs produce
+    identical feature/label sequences regardless of wall time.  Clicks
+    follow a stable per-(user, item) affinity (a seeded hash), giving
+    the online model a learnable signal rather than label noise.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        users: int = 512,
+        items: int = 128,
+        records_per_poll: int = 64,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.users = int(users)
+        self.items = int(items)
+        self.records_per_poll = int(records_per_poll)
+        self._clock = clock
+        self._rng = np.random.default_rng(int(seed) & 0xFFFFFFFF)
+        # Per-user and per-item propensities drawn once: clicked ~
+        # Bernoulli(sigmoid(u_bias + i_bias)), deterministic given seed.
+        self._user_bias = self._rng.normal(0.0, 1.0, self.users)
+        self._item_bias = self._rng.normal(0.0, 1.0, self.items)
+        self.emitted = 0
+
+    def poll(self, max_records: Optional[int] = None) -> List[dict]:
+        """Next batch of impressions, event-stamped at the current
+        clock.  Deterministic content; never blocks."""
+        n = self.records_per_poll if max_records is None else int(max_records)
+        if n <= 0:
+            return []
+        now = float(self._clock())
+        users = self._rng.integers(0, self.users, n)
+        items = self._rng.integers(0, self.items, n)
+        logits = self._user_bias[users] + self._item_bias[items]
+        prob = 1.0 / (1.0 + np.exp(-logits))
+        clicked = (self._rng.random(n) < prob).astype(np.int64)
+        records = [
+            {
+                "user": int(users[i]),
+                "item": int(items[i]),
+                "clicked": int(clicked[i]),
+                "event_unix_s": now,
+            }
+            for i in range(n)
+        ]
+        self.emitted += n
+        return records
+
+
+class StreamWindow:
+    """One sealed window: a finite, immutable slice of the stream."""
+
+    __slots__ = ("name", "window_id", "records", "watermark_unix_s")
+
+    def __init__(self, name: str, window_id: int, records: List[dict],
+                 watermark_unix_s: float):
+        self.name = name
+        self.window_id = window_id
+        self.records = records
+        self.watermark_unix_s = watermark_unix_s
+
+
+class StreamReader(AbstractDataReader):
+    """Buffers an unbounded source into bounded, shard-addressable
+    windows.  Thread-safe: the pipeline polls from its loop thread while
+    training workers call `read_records` on leased tasks."""
+
+    def __init__(
+        self,
+        source,
+        window_records: int = 256,
+        max_buffered_windows: int = 64,
+        registry: Optional[metrics_lib.MetricsRegistry] = None,
+        clock: Callable[[], float] = time.time,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        if window_records < 1:
+            raise ValueError("window_records must be >= 1")
+        self._source = source
+        self._window_records = int(window_records)
+        self._max_buffered = max(1, int(max_buffered_windows))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._current: List[dict] = []
+        self._sealed: "OrderedDict[str, StreamWindow]" = OrderedDict()
+        self._unclaimed: List[StreamWindow] = []  # sealed, not yet armed
+        self._next_window_id = 0
+        self._watermark_unix_s: Optional[float] = None
+
+        self.metrics_registry = (
+            registry if registry is not None else metrics_lib.MetricsRegistry()
+        )
+        self._records = self.metrics_registry.counter(
+            "data_stream_records_total",
+            "records pulled from the stream source",
+        )
+        self._polls = self.metrics_registry.counter(
+            "data_stream_polls_total",
+            "stream poll attempts (stalled or not)",
+        )
+        self._poll_faults = self.metrics_registry.counter(
+            "data_stream_poll_faults_total",
+            "polls stalled by an injected stream.poll fault",
+        )
+        self._sealed_total = self.metrics_registry.counter(
+            "data_stream_windows_sealed_total",
+            "bounded windows closed and made shard-addressable",
+        )
+        self._dropped_total = self.metrics_registry.counter(
+            "data_stream_windows_dropped_total",
+            "sealed windows evicted past the buffer cap",
+        )
+        self.metrics_registry.gauge_fn(
+            "data_stream_watermark_lag_seconds",
+            self.lag_s,
+            "now minus the newest sealed event timestamp",
+        )
+        self.metrics_registry.gauge_fn(
+            "data_stream_buffered_windows_count",
+            lambda: float(len(self._sealed)),
+            "sealed windows awaiting training",
+        )
+
+    # ---- streaming side -------------------------------------------------
+
+    def poll(self, max_records: Optional[int] = None) -> int:
+        """One pull from the source.  Returns records buffered (0 on an
+        injected stall).  Fires `stream.poll` (docs/ROBUSTNESS.md): a
+        raise/drop skips the pull — the source re-delivers next poll —
+        so a scheduled fault reads as watermark lag, never data loss."""
+        self._polls.inc()
+        try:
+            faults.fire(faults.POINT_STREAM_POLL)
+        except faults.InjectedFault as exc:
+            self._poll_faults.inc()
+            logger.warning("stream poll stalled (%s)", exc)
+            return 0
+        records = self._source.poll(max_records)
+        if not records:
+            return 0
+        sealed: List[StreamWindow] = []
+        with self._lock:
+            self._current.extend(records)
+            while len(self._current) >= self._window_records:
+                chunk = self._current[: self._window_records]
+                self._current = self._current[self._window_records:]
+                sealed.append(self._seal_locked(chunk))
+        self._records.inc(len(records))
+        for window in sealed:
+            self._sealed_total.inc()
+            events.emit(
+                events.STREAM_WINDOW_SEALED,
+                window=window.window_id,
+                records=len(window.records),
+            )
+        return len(records)
+
+    def _seal_locked(self, chunk: List[dict]) -> StreamWindow:
+        window_id = self._next_window_id
+        self._next_window_id += 1
+        watermark = max(
+            float(r.get("event_unix_s", 0.0)) for r in chunk
+        )
+        if self._watermark_unix_s is None \
+                or watermark > self._watermark_unix_s:
+            self._watermark_unix_s = watermark
+        window = StreamWindow(
+            f"stream:w{window_id:06d}", window_id, chunk, watermark
+        )
+        self._sealed[window.name] = window
+        self._unclaimed.append(window)
+        while len(self._sealed) > self._max_buffered:
+            name, dropped = self._sealed.popitem(last=False)
+            self._unclaimed = [
+                w for w in self._unclaimed if w.name != name
+            ]
+            self._dropped_total.inc()
+            logger.warning(
+                "stream window %s dropped (buffer cap %d; training is "
+                "%d windows behind)", name, self._max_buffered,
+                len(self._sealed),
+            )
+            del dropped
+        return window
+
+    def take_new_windows(self) -> List[StreamWindow]:
+        """Windows sealed since the last call — the pipeline hands each
+        to `TaskManager.arm_window` exactly once (re-offering itself on
+        an injected re-arm fault)."""
+        with self._lock:
+            out, self._unclaimed = self._unclaimed, []
+            return out
+
+    def release_window(self, name: str) -> bool:
+        """Free a fully-trained window's records."""
+        with self._lock:
+            return self._sealed.pop(name, None) is not None
+
+    # ---- lag ------------------------------------------------------------
+
+    @property
+    def watermark_unix_s(self) -> Optional[float]:
+        with self._lock:
+            return self._watermark_unix_s
+
+    def lag_s(self) -> float:
+        """clock() - watermark; 0.0 before the first sealed window."""
+        watermark = self.watermark_unix_s
+        if watermark is None:
+            return 0.0
+        return max(0.0, float(self._clock()) - watermark)
+
+    # ---- AbstractDataReader contract ------------------------------------
+
+    def read_records(self, task) -> Iterator[dict]:
+        with self._lock:
+            window = self._sealed.get(task.shard.name)
+            records = list(window.records) if window is not None else []
+        if not records:
+            raise LookupError(
+                f"stream window {task.shard.name!r} is no longer "
+                "buffered (trained and released, or dropped past the "
+                "buffer cap)"
+            )
+        end = min(task.shard.end, len(records))
+        for i in range(task.shard.start, end):
+            yield records[i]
+
+    def create_shards(self) -> List[Tuple[str, int, int]]:
+        """The currently-buffered sealed windows.  Unlike batch readers
+        this is a moving view — the perpetual task manager consumes
+        windows incrementally via `take_new_windows` instead."""
+        with self._lock:
+            return [
+                (w.name, 0, len(w.records))
+                for w in self._sealed.values()
+            ]
+
+    @property
+    def metadata(self) -> dict:
+        return {"unbounded": True, "window_records": self._window_records}
+
+    def snapshot(self) -> dict:
+        """Clock-free-ish health summary (lag is clock-derived) for the
+        pipeline's snapshot()/varz."""
+        with self._lock:
+            buffered = len(self._sealed)
+            pending = len(self._current)
+            next_id = self._next_window_id
+        return {
+            "windows_sealed": next_id,
+            "buffered_windows": buffered,
+            "pending_records": pending,
+            "records": int(self._records.value()),
+            "polls": int(self._polls.value()),
+            "poll_faults": int(self._poll_faults.value()),
+            "dropped_windows": int(self._dropped_total.value()),
+            "watermark_lag_s": round(self.lag_s(), 6),
+        }

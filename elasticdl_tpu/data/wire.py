@@ -213,13 +213,44 @@ def frequency_rank(values: np.ndarray):
     return uniq[order], counts[order].astype(np.int64)
 
 
+def field_disjoint_ids(sparse: np.ndarray) -> np.ndarray:
+    """(B, F) per-field ids -> int64 values distinct across fields
+    (`id * F + field`).  The tiered store's vocabulary keys (field, id)
+    — the same raw id in two fields is two different store rows — so a
+    batch-global frequency ranking is only meaningful over values that
+    never collide across fields.  Both the ranking producer
+    (DedupPacker over this encoding, model_zoo deepfm_tiered feeds) and
+    `TieredStore.prepare`'s ranking-to-row translation use THIS helper,
+    so the two sides cannot disagree on the encoding."""
+    sparse = np.asarray(sparse, np.int64)
+    if sparse.ndim != 2:
+        raise ValueError(f"expected (B, F) ids; got {sparse.shape}")
+    f = sparse.shape[1]
+    if sparse.size and int(sparse.max()) > (
+        (np.iinfo(np.int64).max - f) // max(f, 1)
+    ):
+        raise ValueError(
+            "ids too large to field-encode without int64 overflow"
+        )
+    return sparse * f + np.arange(f, dtype=np.int64)[None, :]
+
+
 def pack_rows_dedup(
-    rows: np.ndarray, unique_pad: int = 0, exc_pad: int = 0
-) -> dict:
+    rows: np.ndarray, unique_pad: int = 0, exc_pad: int = 0,
+    return_ranking: bool = False,
+):
     """Host-side: (B, F) pre-hashed non-negative table rows -> dedup'd
     struct.  `unique_pad`/`exc_pad` pad the variable-length planes up to
     fixed sizes (0 = exact); callers wanting shape stability across
-    batches should go through `DedupPacker`."""
+    batches should go through `DedupPacker`.
+
+    With `return_ranking` the per-field frequency work this pack already
+    does is merged into the batch-global `(uniq, counts)` admission
+    signal — identical (values, order, tie-breaks) to
+    `frequency_rank(rows.reshape(-1))` — and returned as
+    `(packed, ranking)` so the tiered store's hot-row cache
+    (store/cache.py `HotRowCache.plan(ranked=...)`) can admit on it
+    instead of re-deriving the counts from the raw batch."""
     rows = np.asarray(rows)
     if rows.ndim != 2:
         raise ValueError(f"dedup packing needs (B, F) rows; got {rows.shape}")
@@ -237,12 +268,14 @@ def pack_rows_dedup(
     hi = int(rows.max()) + 1 if rows.size else 1
     use_bincount = hi <= max(4 * rows.size, 1 << 20)
     lut = np.empty(hi, np.int32) if use_bincount else None
+    field_uniqs, field_counts = [], []
     for k in range(f):
         col = rows[:, k]
         if use_bincount:
             counts = np.bincount(col, minlength=hi)
             uniq = np.nonzero(counts)[0]
-            order = np.argsort(-counts[uniq], kind="stable")
+            counts = counts[uniq]
+            order = np.argsort(-counts, kind="stable")
             uniq_ranked = uniq[order]
             lut[uniq_ranked] = np.arange(len(uniq), dtype=np.int32)
             all_ranks[:, k] = lut[col]
@@ -255,6 +288,9 @@ def pack_rows_dedup(
             rank_of[order] = np.arange(len(uniq), dtype=np.int32)
             all_ranks[:, k] = rank_of[inv]
             uniq_ranked = uniq[order]
+        if return_ranking:
+            field_uniqs.append(np.asarray(uniq_ranked, np.int64))
+            field_counts.append(np.asarray(counts[order], np.int64))
         uniques.append(uniq_ranked.astype(np.uint32))
         starts[k] = total
         total += len(uniq_ranked)
@@ -270,7 +306,22 @@ def pack_rows_dedup(
     }
     if unique_pad or exc_pad:
         packed = pad_dedup(packed, unique_pad, exc_pad)
-    return packed
+    if not return_ranking:
+        return packed
+    # Merge the per-field rankings into the batch-global one with the
+    # SAME tie-break as frequency_rank: ascending-unique base order, then
+    # a stable descending-count argsort (ties -> smaller value first).
+    if field_uniqs:
+        vals = np.concatenate(field_uniqs)
+        cnts = np.concatenate(field_counts)
+        uniq_all, inverse = np.unique(vals, return_inverse=True)
+        totals = np.zeros(len(uniq_all), np.int64)
+        np.add.at(totals, inverse, cnts)
+        order = np.argsort(-totals, kind="stable")
+        ranking = (uniq_all[order], totals[order])
+    else:
+        ranking = (np.empty(0, np.int64), np.empty(0, np.int64))
+    return packed, ranking
 
 
 def pad_dedup(packed: dict, unique_pad: int, exc_pad: int) -> dict:
@@ -365,9 +416,14 @@ class DedupPacker:
         self.exc_cap = 0
         self.last_unique = 0
         self.last_exceptions = 0
+        # Batch-global (uniq, counts) of the most recent pack — the
+        # tiered store's admission signal, so the hot-row cache rides the
+        # frequency work the wire format already paid for instead of
+        # re-ranking the batch (store/cache.py HotRowCache.plan).
+        self.last_ranking = None
 
     def pack(self, rows: np.ndarray) -> dict:
-        exact = pack_rows_dedup(rows)
+        exact, self.last_ranking = pack_rows_dedup(rows, return_ranking=True)
         n_unique = int(exact["unique"].shape[0])
         n_exc = int(exact["exc_val"].shape[0])
         self.last_unique, self.last_exceptions = n_unique, n_exc

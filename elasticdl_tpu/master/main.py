@@ -502,6 +502,10 @@ class Master:
         from elasticdl_tpu.common import faults, resilience
 
         out = {"tasks": self.task_manager.snapshot()}
+        online = self.task_manager.online_snapshot()
+        if online is not None:
+            # perpetual (online) jobs: the `elasticdl top` online line
+            out["online"] = online
         if self.recovery_clock is not None:
             out["recovery"] = self.recovery_clock.snapshot()
         if self.pod_manager is not None:
@@ -516,6 +520,15 @@ class Master:
             slo = self.slo_evaluator.snapshot()
             if self.metric_history is not None:
                 slo["history"] = self.metric_history.snapshot()
+                if online is not None:
+                    # stream-lag coverage for `elasticdl slo`: how many
+                    # samples of the armed-watermark lag gauge the
+                    # history holds (docs/ONLINE.md)
+                    slo["history"]["stream_lag_samples"] = len(
+                        self.metric_history.series(
+                            "master_stream_watermark_lag_seconds"
+                        )
+                    )
             out["slo"] = slo
         out["workers"] = self.servicer.worker_telemetry()
         # Straggler stats come from the task manager's lease clock, not

@@ -30,7 +30,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
-from elasticdl_tpu.common import events
+from elasticdl_tpu.common import events, faults
 from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.proto import elasticdl_pb2 as pb
@@ -173,6 +173,7 @@ class TaskManager:
         straggler_multiple: float = 3.0,
         straggler_min_tasks: int = 3,
         clock: Callable[[], float] = time.time,
+        perpetual: bool = False,
     ):
         self._lock = threading.Lock()
         # Injectable clock: every lease/duration/dwell timestamp reads it,
@@ -249,6 +250,36 @@ class TaskManager:
             "workers currently flagged as stragglers (mean task "
             "duration > --straggler_multiple x fleet median)",
         )
+        # Perpetual (online) mode: the queue never drains for good —
+        # sealed stream windows re-arm it via `arm_window` and the job
+        # only ends when the pipeline stops it (docs/ONLINE.md).  The
+        # watermark of the last armed window feeds the stream-lag gauge
+        # the SLO history samples.
+        self._perpetual = bool(perpetual)
+        self._armed_windows = 0
+        self._armed_tasks = 0
+        self._last_window_id = -1
+        self._last_window_name = ""
+        self._armed_watermark_unix_s: Optional[float] = None
+        if self._perpetual:
+            self._windows_armed_counter = self.counters.registry.counter(
+                "master_stream_windows_armed_total",
+                "sealed stream windows turned into queue tasks",
+            )
+            self._tasks_rearmed_counter = self.counters.registry.counter(
+                "master_stream_tasks_rearmed_total",
+                "training tasks created by window re-arms",
+            )
+            self._rearm_faults_counter = self.counters.registry.counter(
+                "master_stream_rearm_faults_total",
+                "window re-arms skipped by an injected task.rearm fault",
+            )
+            self.counters.registry.gauge_fn(
+                "master_stream_watermark_lag_seconds",
+                self._armed_watermark_lag,
+                "now minus the watermark of the last armed window — the "
+                "stream-lag series elasticdl slo covers",
+            )
         self._completion_callbacks: List[Callable[[pb.Task, bool], None]] = []
         self._all_done_callbacks: List[Callable[[], None]] = []
         # Pre-finish providers get one chance to inject final work (e.g.
@@ -472,6 +503,88 @@ class TaskManager:
             self._training_records_done,
         )
         self._persist_locked()
+
+    # ---- perpetual (online) mode ---------------------------------------
+
+    def arm_window(
+        self,
+        window_name: str,
+        num_records: int,
+        records_per_task: int,
+        watermark_unix_s: Optional[float] = None,
+        window_id: Optional[int] = None,
+    ) -> Optional[int]:
+        """Turn one sealed stream window into TRAINING tasks (perpetual
+        mode's replacement for epoch refills).  Returns the number of
+        tasks armed, or None when an injected `task.rearm` fault skipped
+        the re-arm ATOMICALLY (no tasks enqueued; the caller keeps the
+        window pending and re-offers it — docs/ROBUSTNESS.md)."""
+        if not self._perpetual:
+            raise RuntimeError(
+                "arm_window requires TaskManager(perpetual=True)"
+            )
+        try:
+            faults.fire(faults.POINT_TASK_REARM)
+        except faults.InjectedFault as exc:
+            self._rearm_faults_counter.inc()
+            logger.warning(
+                "window %s re-arm skipped (%s); caller retries",
+                window_name, exc,
+            )
+            return None
+        per_task = max(1, int(records_per_task))
+        with self._lock:
+            n = 0
+            for lo in range(0, int(num_records), per_task):
+                shard = pb.Shard(
+                    name=window_name, start=lo,
+                    end=min(lo + per_task, int(num_records)),
+                )
+                self._todo.append(self._new_task(shard, pb.TRAINING))
+                n += 1
+            self._armed_windows += 1
+            self._armed_tasks += n
+            self._last_window_name = window_name
+            if window_id is not None:
+                self._last_window_id = int(window_id)
+            if watermark_unix_s is not None:
+                self._armed_watermark_unix_s = float(watermark_unix_s)
+            # a re-arm revives a queue that momentarily drained
+            self._finished = False
+        self._windows_armed_counter.inc()
+        self._tasks_rearmed_counter.inc(n)
+        events.emit(
+            events.STREAM_WINDOW_ARMED,
+            window=int(window_id) if window_id is not None
+            else window_name,
+            tasks=n,
+        )
+        return n
+
+    def _armed_watermark_lag(self) -> float:
+        watermark = self._armed_watermark_unix_s
+        if watermark is None:
+            return 0.0
+        return max(0.0, float(self._clock()) - watermark)
+
+    def online_snapshot(self) -> Optional[dict]:
+        """Perpetual-mode progress for snapshot()["online"] and the
+        `elasticdl top` online line; None outside perpetual mode."""
+        if not self._perpetual:
+            return None
+        with self._lock:
+            return {
+                "window": self._last_window_id,
+                "window_name": self._last_window_name,
+                "windows_armed": self._armed_windows,
+                "tasks_rearmed": self._armed_tasks,
+                "rearm_faults": int(self._rearm_faults_counter.value()),
+                "watermark_lag_s": round(self._armed_watermark_lag(), 6),
+            }
+
+    @property
+    def perpetual(self) -> bool:
+        return self._perpetual
 
     def create_evaluation_tasks(self, model_version: int) -> int:
         """Inject evaluation tasks (called by the evaluation service)."""
@@ -781,6 +894,10 @@ class TaskManager:
             self._fire_all_done()
 
     def _check_all_done_locked(self) -> bool:
+        if self._perpetual:
+            # An online job never self-finishes: a drained queue just
+            # means the next window has not been armed yet.
+            return False
         if self._finished:
             return False
         done = (
@@ -836,8 +953,9 @@ class TaskManager:
         return thread
 
     def snapshot(self) -> dict:
+        online = self.online_snapshot()
         with self._lock:
-            return {
+            out = {
                 "todo": len(self._todo),
                 "doing": len(self._doing),
                 "epoch": self._epoch,
@@ -850,3 +968,6 @@ class TaskManager:
                 "transient_requeues": sum(self._transient_count.values()),
                 "stragglers": sorted(self._stragglers),
             }
+        if online is not None:
+            out["online"] = online
+        return out

@@ -1,0 +1,4 @@
+from elasticdl_tpu.online.pipeline import (  # noqa: F401
+    OnlineConfig,
+    OnlinePipeline,
+)

@@ -1,0 +1,394 @@
+"""Online continuous learning: one loop from stream to served model.
+
+The batch system in this repo runs stream -> train -> checkpoint ->
+hot-reload as four separately-benched pieces.  `OnlinePipeline` closes
+them into one measured loop (docs/ONLINE.md):
+
+    ClickStreamSource -> StreamReader (bounded windows, watermark)
+        -> TaskManager(perpetual=True).arm_window  (queue re-arms forever)
+        -> Trainer.train_on_batch per leased task
+        -> CheckpointSaver every `checkpoint_every_windows` windows
+           (keep-last-K sweep + freshness stamp)
+        -> ServingFleetManager.tick  (sequenced hot-swaps behind the
+           FleetRouter, live traffic keeps flowing)
+        -> FreshnessTracker + MetricHistory + SloEvaluator
+           (staleness_p99 measures REAL stream-to-serve lag)
+
+Every time-reading collaborator shares ONE injectable clock, and every
+decision maker (task manager, fleet manager, SLO evaluator, fault
+registry) is already deterministic under a fake clock — so the chaos
+variant of `bench.py --online` replays byte-identically across
+same-seed runs while a stream stall, a replica kill, and a reload fault
+land mid-loop.
+
+Single-process by design: the serving replicas are in-process servicers
+behind killable clients (the bench_serving_fleet harness shape,
+bench.py), which keeps the full loop runnable in CI seconds.  The
+multi-process story reuses the same pieces unchanged — the reader and
+task manager already speak the worker lease protocol.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.history import MetricHistory
+from elasticdl_tpu.common.k8s_client import FakeK8sClient
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.common.log_utils import get_logger
+from elasticdl_tpu.common.resilience import RetryPolicy
+from elasticdl_tpu.common.save_utils import CheckpointSaver
+from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
+from elasticdl_tpu.data.reader.stream_reader import (
+    ClickStreamSource,
+    StreamReader,
+)
+from elasticdl_tpu.master.freshness import FreshnessTracker
+from elasticdl_tpu.master.serving_fleet import (
+    ServingFleetConfig,
+    ServingFleetManager,
+)
+from elasticdl_tpu.master.task_manager import TaskManager
+from elasticdl_tpu.proto.service import FleetRouter, InProcessServingClient
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class OnlineConfig:
+    """Shape of one online loop.  Defaults are CI-sized: a few hundred
+    records per window, two replicas, a checkpoint every other window."""
+
+    seed: int = 0
+    window_records: int = 128
+    records_per_task: int = 32
+    records_per_poll: int = 64
+    max_buffered_windows: int = 64
+    checkpoint_every_windows: int = 2
+    keep_max: int = 3
+    replicas: int = 2
+    probe_failures: int = 2
+    step_skew_slo: int = 16
+    source_users: int = 512
+    source_items: int = 128
+
+
+class _KillableClient:
+    """In-process serving client with a kill switch standing in for a
+    dead pod (same harness shape as bench_serving_fleet)."""
+
+    def __init__(self, servicer):
+        self._inner = InProcessServingClient(servicer)
+        self.killed = False
+
+    def predict(self, request, timeout=None):
+        if self.killed:
+            raise ConnectionError("replica killed")
+        return self._inner.predict(request, timeout=timeout)
+
+    def health(self, request, timeout=None):
+        if self.killed:
+            raise ConnectionError("replica killed")
+        return self._inner.health(request, timeout=timeout)
+
+
+class OnlinePipeline:
+    """Builds and drives the whole loop.  `tick()` is one iteration:
+    poll the stream, arm sealed windows, train the leased tasks,
+    checkpoint on cadence, tick the serving fleet and the SLO watcher.
+    Call it forever (the real deployment) or N times (bench/tests)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        spec,
+        config: Optional[OnlineConfig] = None,
+        clock: Callable[[], float] = time.time,
+        source=None,
+    ):
+        import jax
+
+        from elasticdl_tpu.serving.batcher import DynamicBatcher
+        from elasticdl_tpu.serving.engine import ServingEngine
+        from elasticdl_tpu.serving.reloader import CheckpointReloader
+        from elasticdl_tpu.serving.server import ServingServicer
+        from elasticdl_tpu.worker.trainer import Trainer
+
+        self.config = cfg = config or OnlineConfig()
+        self.spec = spec
+        self._clock = clock
+
+        # ---- stream -> windows ------------------------------------------
+        self.source = source if source is not None else ClickStreamSource(
+            seed=cfg.seed, users=cfg.source_users, items=cfg.source_items,
+            records_per_poll=cfg.records_per_poll, clock=clock,
+        )
+        self.reader = StreamReader(
+            self.source, window_records=cfg.window_records,
+            max_buffered_windows=cfg.max_buffered_windows, clock=clock,
+        )
+        self._pending_windows = []          # sealed, not yet armed
+        self._window_tasks_left = {}        # window name -> tasks open
+
+        # ---- perpetual task queue ---------------------------------------
+        self.task_manager = TaskManager(perpetual=True, clock=clock)
+
+        # ---- trainer -----------------------------------------------------
+        self.trainer = Trainer(spec.model, spec.optimizer, spec.loss)
+        sample = spec.feed(
+            ClickStreamSource(
+                seed=cfg.seed, users=cfg.source_users,
+                items=cfg.source_items, clock=lambda: 0.0,
+            ).poll(2),
+            self.reader.metadata,
+        )["features"]
+        self._sample = np.asarray(sample)
+        self.state = self.trainer.init_state(
+            jax.random.PRNGKey(cfg.seed), self._sample
+        )
+
+        # ---- checkpoints -------------------------------------------------
+        self.saver = CheckpointSaver(
+            checkpoint_dir, keep_max=cfg.keep_max, async_save=False,
+            clock=clock,
+        )
+        # An initial step-0 checkpoint so the serving fleet has a model
+        # before the first window finishes training.
+        self.saver.save(self.state, force=True)
+        self.saver.wait_until_finished()
+        self._latest_saved = int(self.state.step)
+        self._windows_since_save = 0
+        self._windows_trained = 0
+        self._examples_trained = 0
+        self._last_loss = float("nan")
+
+        # ---- serving fleet (in-process replicas) ------------------------
+        self.k8s = FakeK8sClient()
+        self.freshness = FreshnessTracker(
+            clock=clock,
+            produced_time_fn=lambda step: (
+                self.saver.produced_meta(step) or {}
+            ).get("produced_unix_s"),
+        )
+        self.router = FleetRouter(
+            retry_policy=RetryPolicy(
+                initial_backoff_s=0.001, max_backoff_s=0.01,
+                max_elapsed_s=30.0, max_attempts=8,
+            ),
+            freshness=self.freshness,
+        )
+        self._fleet = {}
+        for rid in range(cfg.replicas):
+            engine = ServingEngine.from_checkpoint(
+                checkpoint_dir, spec, self._sample, buckets=(2, 8)
+            )
+            batcher = DynamicBatcher(engine, max_latency_s=0.002)
+            reloader = CheckpointReloader(
+                engine, checkpoint_dir, poll_interval_s=3600.0
+            )
+            self._fleet[rid] = {
+                "engine": engine,
+                "batcher": batcher,
+                "reloader": reloader,
+                "servicer": ServingServicer(engine, batcher, reloader),
+                "client": None,
+            }
+
+        def client_factory(rid, _addr):
+            self._fleet[rid]["client"] = _KillableClient(
+                self._fleet[rid]["servicer"]
+            )
+            return self._fleet[rid]["client"]
+
+        self.fleet_manager = ServingFleetManager(
+            self.k8s,
+            ServingFleetConfig(
+                replicas=cfg.replicas, interval_s=0.0,
+                probe_failures=cfg.probe_failures,
+                step_skew_slo=cfg.step_skew_slo,
+            ),
+            job_name="online",
+            client_factory=client_factory,
+            reload_fn=lambda rid: self._fleet[rid][
+                "reloader"
+            ].check_once(),
+            pending_step_fn=lambda: self._latest_saved,
+            router=self.router,
+            clock=clock,
+            freshness=self.freshness,
+        )
+        self.fleet_manager.place()
+        self.fleet_manager.tick()   # prime: every replica probed healthy
+
+        # ---- SLO watcher -------------------------------------------------
+        # The history samples the stream-lag gauges alongside the
+        # freshness/fleet series, so `elasticdl slo` history coverage
+        # includes the stream-lag series (docs/OBSERVABILITY.md).
+        self.history = MetricHistory(
+            registries=[
+                self.freshness.metrics_registry,
+                self.fleet_manager.metrics_registry,
+                self.reader.metrics_registry,
+                self.task_manager.counters.registry,
+            ],
+            clock=clock,
+        )
+        self.evaluator = SloEvaluator(
+            self.history, specs=[shipped_specs()[0]], clock=clock,
+        )
+        self.max_burn = 0.0
+        self.ticks = 0
+
+    # ---- one loop iteration ---------------------------------------------
+
+    def tick(self) -> dict:
+        """Poll -> arm -> train -> checkpoint -> serve.  Returns a small
+        progress dict for the caller's loop telemetry."""
+        polled = self.reader.poll()
+        self._arm_pending()
+        trained = self._drain_tasks()
+        saved = self._maybe_checkpoint()
+        self.fleet_manager.tick()
+        self.history.tick()
+        self.evaluator.tick()
+        self.max_burn = max(self.max_burn, self.evaluator.max_burn())
+        self.ticks += 1
+        return {
+            "polled": polled,
+            "trained_tasks": trained,
+            "checkpointed": saved,
+            "model_step": int(self.state.step),
+            "loss": self._last_loss,
+        }
+
+    def _arm_pending(self) -> None:
+        self._pending_windows.extend(self.reader.take_new_windows())
+        still_pending = []
+        for window in self._pending_windows:
+            n = self.task_manager.arm_window(
+                window.name, len(window.records),
+                self.config.records_per_task,
+                watermark_unix_s=window.watermark_unix_s,
+                window_id=window.window_id,
+            )
+            if n is None:
+                # injected task.rearm fault: the window stays pending and
+                # is re-offered next tick (docs/ROBUSTNESS.md)
+                still_pending.append(window)
+            else:
+                self._window_tasks_left[window.name] = n
+        self._pending_windows = still_pending
+
+    def _drain_tasks(self) -> int:
+        trained = 0
+        while True:
+            task = self.task_manager.get(0)
+            if task is None:
+                return trained
+            name = task.shard.name
+            try:
+                records = list(self.reader.read_records(task))
+            except LookupError:
+                # The window was dropped past the buffer cap: its data is
+                # gone for good, so retire the task (success, 0 records)
+                # rather than retry-looping on an unservable shard.
+                self.task_manager.report(task.task_id, True, worker_id=0)
+                self._window_done(name)
+                continue
+            batch = self.spec.feed(records, self.reader.metadata)
+            self.state, loss = self.trainer.train_on_batch(
+                self.state, batch
+            )
+            self._last_loss = float(loss)
+            self._examples_trained += len(records)
+            trained += 1
+            self.task_manager.report(
+                task.task_id, True, worker_id=0, records=len(records),
+                model_version=int(self.state.step),
+            )
+            self._window_done(name)
+
+    def _window_done(self, name: str) -> None:
+        left = self._window_tasks_left.get(name)
+        if left is None:
+            return
+        left -= 1
+        if left > 0:
+            self._window_tasks_left[name] = left
+            return
+        del self._window_tasks_left[name]
+        self.reader.release_window(name)
+        self._windows_trained += 1
+        self._windows_since_save += 1
+
+    def _maybe_checkpoint(self) -> bool:
+        if self._windows_since_save < self.config.checkpoint_every_windows:
+            return False
+        self._windows_since_save = 0
+        if not self.saver.save(self.state, force=True):
+            return False   # injected checkpoint.write fault: next cadence
+        self.saver.wait_until_finished()
+        self._latest_saved = int(self.state.step)
+        return True
+
+    # ---- serve side -------------------------------------------------------
+
+    def predict(self, request):
+        """Route one predict through the live fleet (retries/failover per
+        the router's policy)."""
+        return self.router.predict(request)
+
+    def kill_replica(self, rid: int) -> None:
+        """Chaos helper: kill transport AND pod so the next fleet tick
+        sees a FAILED replica and relaunches it."""
+        client = self._fleet[rid]["client"]
+        if client is not None:
+            client.killed = True
+        pod = self.fleet_manager.snapshot()["replicas"][rid]["pod"]
+        self.k8s.emit(pod, PodStatus.FAILED, exit_code=1)
+
+    # ---- introspection ----------------------------------------------------
+
+    def online_snapshot(self) -> dict:
+        """The task manager's online progress, merged with the serving
+        side's last reloaded step — the `elasticdl top` online line."""
+        online = self.task_manager.online_snapshot() or {}
+        fleet = self.fleet_manager.snapshot()
+        steps = [
+            rep.get("model_step", 0)
+            for rep in fleet.get("replicas", {}).values()
+        ]
+        online["last_reload_step"] = max(steps) if steps else 0
+        return online
+
+    def snapshot(self) -> dict:
+        slo = self.evaluator.snapshot()
+        slo["history"] = self.history.snapshot()
+        # stream-lag coverage for `elasticdl slo` (same annotation the
+        # master makes for perpetual jobs)
+        slo["history"]["stream_lag_samples"] = len(
+            self.history.series("master_stream_watermark_lag_seconds")
+        )
+        return {
+            "ticks": self.ticks,
+            "online": self.online_snapshot(),
+            "stream": self.reader.snapshot(),
+            "tasks": self.task_manager.snapshot(),
+            "serving_fleet": self.fleet_manager.snapshot(),
+            "freshness": self.freshness.snapshot(),
+            "slo": slo,
+            "windows_trained": self._windows_trained,
+            "examples_trained": self._examples_trained,
+            "model_step": int(self.state.step),
+            "latest_saved_step": self._latest_saved,
+            "max_burn": round(self.max_burn, 6),
+        }
+
+    def shutdown(self) -> None:
+        for rep in self._fleet.values():
+            rep["batcher"].shutdown()
+        self.saver.close()

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 from elasticdl_tpu.common import events, faults
 from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common import save_utils
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.save_utils import CheckpointSaver
 from elasticdl_tpu.worker.trainer import run_device_serialized
@@ -52,6 +53,7 @@ class CheckpointReloader:
             )
         self._engine = engine
         self._template = template
+        self._dir = checkpoint_dir
         self._saver = CheckpointSaver(checkpoint_dir, async_save=False)
         self._poll_interval_s = poll_interval_s
         self._rejected_steps = set()
@@ -76,6 +78,10 @@ class CheckpointReloader:
         if latest is None or latest <= self._engine.step \
                 or latest in self._rejected_steps:
             return False
+        # Pin across the whole verify/restore/swap window: the trainer's
+        # keep-last-K sweep (save_utils) must never delete the step this
+        # swap is reading, however long the restore takes.
+        save_utils.pin_step(self._dir, latest)
         try:
             faults.fire(faults.POINT_SERVING_RELOAD)
             if not self._saver.verify_step(latest):
@@ -101,6 +107,8 @@ class CheckpointReloader:
                 "step %d", latest, exc, self._engine.step,
             )
             return False
+        finally:
+            save_utils.unpin_step(self._dir, latest)
         self._reloads.inc()
         self.last_error = None
         events.emit(events.SERVING_RELOADED, step=latest)

@@ -82,10 +82,26 @@ class HotRowCache:
         """Resident slot for a store row, or -1 (the serving path)."""
         return self._slot_of.get(int(row), -1)
 
-    def plan(self, rows: np.ndarray) -> CachePlan:
+    def plan(self, rows: np.ndarray, ranked=None) -> CachePlan:
+        """`ranked` is an optional precomputed `(uniq, counts)` admission
+        signal for exactly these rows — DedupPacker.last_ranking, merged
+        batch-globally by the wire pack — so the cache doesn't re-derive
+        the frequency view the packer already built.  Order and
+        tie-breaks must match `frequency_rank(rows.reshape(-1))`
+        (admission order is eviction-victim-visible); the wire pack
+        guarantees that, and the parity test pins it."""
         rows = np.asarray(rows, np.int64)
         flat = rows.reshape(-1)
-        uniq, counts = frequency_rank(flat)
+        if ranked is None:
+            uniq, counts = frequency_rank(flat)
+        else:
+            uniq = np.asarray(ranked[0], np.int64)
+            counts = np.asarray(ranked[1], np.int64)
+            if int(counts.sum()) != flat.size:
+                raise ValueError(
+                    f"precomputed ranking covers {int(counts.sum())} "
+                    f"lookups but the batch has {flat.size}"
+                )
         if uniq.size > self.capacity:
             raise ValueError(
                 f"batch touches {uniq.size} unique rows but the cache "

@@ -191,13 +191,51 @@ class TieredStore:
 
     # ---- producer side -------------------------------------------------
 
-    def prepare(self, sparse: np.ndarray):
+    def prepare(self, sparse: np.ndarray, ranked=None):
         """Producer-side planning: grow vocab, plan cache admissions,
         kick off the async host gather.  Returns (slots, plan).  MUST be
-        called in batch order from a single thread."""
+        called in batch order from a single thread.
+
+        `ranked` is an optional `(uniq_ids, counts)` frequency ranking of
+        THIS batch's FIELD-ENCODED ids (DedupPacker.last_ranking over
+        `wire.field_disjoint_ids(sparse)` — the vocab keys (field, id),
+        so raw ids colliding across fields must not merge).  Encoded
+        value <-> (field, id) <-> store row is then a bijection on the
+        batch, so the counts carry over to rows unchanged — only the
+        unique VALUES need translating, one first-occurrence lookup
+        instead of a full re-rank."""
+        from elasticdl_tpu.data.wire import field_disjoint_ids
+
         with self._lock:
             rows, n_new = self.host.assign(sparse)
-            plan = self.cache.plan(rows)
+            if ranked is not None:
+                uniq_ids = np.asarray(ranked[0], np.int64)
+                flat_ids = field_disjoint_ids(sparse).reshape(-1)
+                flat_rows = np.asarray(rows, np.int64).reshape(-1)
+                sort_idx = np.argsort(flat_ids, kind="stable")
+                sorted_ids = flat_ids[sort_idx]
+                pos = np.searchsorted(sorted_ids, uniq_ids)
+                if pos.size and (
+                    int(pos.max(initial=0)) >= sorted_ids.size
+                    or np.any(sorted_ids[np.minimum(
+                        pos, sorted_ids.size - 1)] != uniq_ids)
+                ):
+                    raise ValueError(
+                        "ranking does not match this batch's encoded "
+                        "ids — rank wire.field_disjoint_ids(sparse), "
+                        "not the raw per-field ids"
+                    )
+                rows_u = flat_rows[sort_idx[pos]]
+                counts_u = np.asarray(ranked[1], np.int64)
+                # Tie-break in ROW space: the wire ranking breaks count
+                # ties toward the smaller encoded id, but admission order
+                # must match `frequency_rank(rows)` (ties -> smaller row;
+                # vocab rows are claimed in first-occurrence order, so
+                # the two orders genuinely differ).  One lexsort over the
+                # k uniques — still no re-count of the full batch.
+                order = np.lexsort((rows_u, -counts_u))
+                ranked = (rows_u[order], counts_u[order])
+            plan = self.cache.plan(rows, ranked=ranked)
             plan.growth = n_new
             for r in plan.evict_rows:
                 self._pending_writeback.add(int(r))
@@ -293,12 +331,16 @@ class TieredStore:
     def attach(self, batch: dict) -> dict:
         """Rewrite one feed batch: raw `sparse` ids become cache `slots`,
         and the plan rides along under `__store_plan__` (popped by the
-        trainer before any tree_map sees the batch)."""
+        trainer before any tree_map sees the batch).  A feed that packed
+        this batch through DedupPacker can leave the packer's ranking
+        under `__dedup_ranking__` (popped here, never shipped) and the
+        admission plan reuses it."""
         features = dict(batch["features"])
         sparse = features.pop("sparse")
-        slots, plan = self.prepare(sparse)
-        features["slots"] = slots
         out = dict(batch)
+        ranked = out.pop("__dedup_ranking__", None)
+        slots, plan = self.prepare(sparse, ranked=ranked)
+        features["slots"] = slots
         out["features"] = features
         out["__store_plan__"] = plan
         return out

@@ -26,6 +26,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from elasticdl_tpu.data.wire import DedupPacker, field_disjoint_ids
 from elasticdl_tpu.layers.arena import TieredArena
 from elasticdl_tpu.store.tiered import TieredStore
 from model_zoo.deepfm.deepfm_functional_api import (  # noqa: F401
@@ -33,8 +34,8 @@ from model_zoo.deepfm.deepfm_functional_api import (  # noqa: F401
     NUM_SPARSE,
     deepfm_tail,
     eval_metrics_fn,
-    feed,
-    feed_bulk,
+    feed as _base_feed,
+    feed_bulk as _base_feed_bulk,
     loss,
     optimizer,
 )
@@ -51,6 +52,33 @@ STORE_SEED = 0x5EED
 # The store the Local runner built last — regression tests reach in here
 # to assert its background threads actually ticked.
 _LAST_STORE = None
+
+# One packer per process: its sticky pad caps are exactly the dedup-wire
+# behaviour, and its per-batch `last_ranking` is the admission ranking
+# the store consumes — computed once here, never re-derived downstream.
+_RANK_PACKER = None
+
+
+def _attach_ranking(batch):
+    """Rank this batch's sparse ids on the wire (DedupPacker over
+    `wire.field_disjoint_ids` — the store's vocab keys (field, id), so
+    raw ids must not merge across fields) and hand the ranking to
+    `TieredStore.attach` via the `__dedup_ranking__` batch key."""
+    global _RANK_PACKER
+    if _RANK_PACKER is None:
+        _RANK_PACKER = DedupPacker()
+    _RANK_PACKER.pack(field_disjoint_ids(batch["features"]["sparse"]))
+    out = dict(batch)
+    out["__dedup_ranking__"] = _RANK_PACKER.last_ranking
+    return out
+
+
+def feed(records, metadata=None):
+    return _attach_ranking(_base_feed(records, metadata))
+
+
+def feed_bulk(buffer, sizes, metadata=None):
+    return _attach_ranking(_base_feed_bulk(buffer, sizes, metadata))
 
 
 class TieredDeepFM(nn.Module):

@@ -1,0 +1,111 @@
+"""Online-loop smoke summary for CI.
+
+Runs a short real-clock pass of the continuous-learning pipeline —
+stream -> perpetual task queue -> train -> checkpoint -> hot-reload
+behind live predicts (docs/ONLINE.md) — and prints one
+machine-readable line:
+
+    ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b>
+
+`scripts/run_tests.sh` emits it next to STORE_SUMMARY / TIER1_SUMMARY
+so CI can watch the online loop's sustained throughput and
+train-to-serve staleness drift without running the full bench
+(`python bench.py --online`).  A few seconds on CPU: two windows, two
+in-process replicas, sequential predicts on the driver thread.
+
+tests/test_online_pipeline.py asserts on `smoke_summary()` directly,
+so the printed numbers and the tested behaviour cannot diverge.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+WINDOWS = 2
+PREDICTS_PER_TICK = 2
+SEED = 0x5EED
+
+
+def smoke_summary(windows: int = WINDOWS,
+                  predicts_per_tick: int = PREDICTS_PER_TICK,
+                  seed: int = SEED) -> dict:
+    """Drive `windows` stream windows through the online loop under a
+    real clock, predicting against the live fleet between ticks.
+    Returns the dict behind the ONLINE_SUMMARY line."""
+    import numpy as np
+
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+    from elasticdl_tpu.proto import serving_pb2 as spb
+    from elasticdl_tpu.serving.server import make_predict_request
+    from model_zoo.clickstream import ctr_mlp
+
+    spec = get_model_spec(
+        os.path.join(_ROOT, "model_zoo"),
+        "clickstream.ctr_mlp.custom_model",
+    )
+    cfg = OnlineConfig(
+        seed=seed, window_records=64, records_per_poll=64,
+        records_per_task=16, checkpoint_every_windows=1, replicas=2,
+    )
+    rng = np.random.RandomState(seed)
+    served = failed = 0
+    with tempfile.TemporaryDirectory() as tmp:
+        pipe = OnlinePipeline(tmp, spec, cfg)
+        t0 = time.perf_counter()
+        ticks = 0
+        while pipe._windows_trained < windows and ticks < windows * 4:
+            pipe.tick()
+            ticks += 1
+            for _ in range(predicts_per_tick):
+                x = ctr_mlp.encode(
+                    rng.randint(0, cfg.source_users, 2),
+                    rng.randint(0, cfg.source_items, 2),
+                )
+                try:
+                    resp = pipe.predict(make_predict_request(x))
+                    ok = resp.code == spb.SERVING_OK
+                except Exception:
+                    ok = False
+                if ok:
+                    served += 1
+                else:
+                    failed += 1
+        elapsed = time.perf_counter() - t0
+        staleness = pipe.freshness.quantiles()
+        snap = pipe.snapshot()
+        pipe.shutdown()
+    return {
+        "train_eps": snap["examples_trained"] / elapsed,
+        "qps": served / elapsed,
+        "staleness_p99_s": staleness["staleness_p99_s"],
+        "burn": snap["max_burn"],
+        "failed_requests": failed,
+        "windows_trained": snap["windows_trained"],
+        "last_reload_step": snap["online"]["last_reload_step"],
+    }
+
+
+def main() -> int:
+    summary = smoke_summary()
+    print(
+        "ONLINE_SUMMARY train_eps={eps:.1f} qps={qps:.1f} "
+        "staleness_p99_s={stale:.4f} burn={burn:.3f}".format(
+            eps=summary["train_eps"],
+            qps=summary["qps"],
+            stale=summary["staleness_p99_s"],
+            burn=summary["burn"],
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

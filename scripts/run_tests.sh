@@ -3,8 +3,9 @@
 # no device), then unit + in-process integration tests on a virtual
 # 8-device CPU mesh, then the native-component build.
 #
-# Always ends with two machine-readable lines:
+# Always ends with three machine-readable lines:
 #   STORE_SUMMARY hit_rate=<r> growth_rows=<n>
+#   ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b>
 #   TIER1_SUMMARY passed=<N> wall_s=<S> lint_findings=<L> status=<ok|fail>
 # so CI (and the roadmap driver) can scrape the tier-1 outcome — and the
 # tiered store's cache efficacy (docs/PERF.md "Tiered embedding store")
@@ -68,5 +69,10 @@ fi
 # numpy, sub-second); failure is non-fatal here — the matching unit
 # test in tests/test_tiered_store.py owns the hard floor.
 python -m scripts.store_summary || true
+# Online continuous-learning loop smoke (docs/ONLINE.md): two stream
+# windows through train -> checkpoint -> hot-reload behind live
+# predicts, a few seconds on CPU; non-fatal here — the matching test
+# in tests/test_online_pipeline.py owns the hard assertions.
+python -m scripts.online_summary || true
 echo "TIER1_SUMMARY passed=${passed} wall_s=${wall_s} lint_findings=${lint_findings} status=${status}"
 exit "$rc"

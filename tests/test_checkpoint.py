@@ -72,6 +72,58 @@ def test_keep_max_rotation(tmp_path):
     saver.close()
 
 
+def test_keep_max_sweep_defers_pinned_step(tmp_path):
+    """A step pinned by an in-flight reloader swap survives the
+    keep-last-K sweep (base dir AND manifest stay), then rotates out
+    normally on the first sweep after unpin (docs/ONLINE.md
+    "Checkpoints: cadence, keep-last-K, pinning")."""
+    import os
+
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common import save_utils
+
+    ckpt = str(tmp_path / "ckpt")
+    trainer = _trainer()
+    state = trainer.init_state(jax.random.PRNGKey(0), _batch()["features"])
+    saver = CheckpointSaver(ckpt, keep_max=2, async_save=False)
+    at_step = lambda i: state.replace(step=jnp.asarray(i, jnp.int32))
+    saver.save(at_step(1), force=True)         # step 1
+    save_utils.pin_step(ckpt, 1)
+    try:
+        for i in range(2, 5):                  # steps 2, 3, 4
+            saver.save(at_step(i), force=True)
+        steps = set(saver._mngr.all_steps())
+        assert 1 in steps                      # pinned: sweep deferred
+        assert steps == {1, 3, 4}              # unpinned excess rotated
+        manifests = {
+            int(os.path.splitext(n)[0])
+            for n in os.listdir(str(tmp_path / "ckpt" / ".manifests"))
+            if n.endswith(".json")
+        }
+        assert manifests == steps              # manifests in lockstep
+    finally:
+        save_utils.unpin_step(ckpt, 1)
+    assert save_utils.pinned_steps(ckpt) == frozenset()
+    saver.save(at_step(5), force=True)         # step 5: sweep catches up
+    assert set(saver._mngr.all_steps()) == {4, 5}
+    saver.close()
+
+
+def test_unpin_without_pin_is_a_noop(tmp_path):
+    from elasticdl_tpu.common import save_utils
+
+    save_utils.unpin_step(str(tmp_path), 3)
+    assert save_utils.pinned_steps(str(tmp_path)) == frozenset()
+    # refcounted: two pins need two unpins
+    save_utils.pin_step(str(tmp_path), 3)
+    save_utils.pin_step(str(tmp_path), 3)
+    save_utils.unpin_step(str(tmp_path), 3)
+    assert save_utils.pinned_steps(str(tmp_path)) == frozenset({3})
+    save_utils.unpin_step(str(tmp_path), 3)
+    assert save_utils.pinned_steps(str(tmp_path)) == frozenset()
+
+
 def test_maybe_restore_empty_dir_returns_none(tmp_path):
     saver = CheckpointSaver(str(tmp_path / "empty"), async_save=False)
     assert saver.maybe_restore(template=None) is None

@@ -1,0 +1,168 @@
+"""Online continuous-learning loop acceptance (docs/ONLINE.md): the
+stream -> perpetual-train -> checkpoint -> hot-reload pipeline sustains
+multiple reload cycles behind live predicts with zero failures, the
+chaos variant (stream stall + window re-arm loss + rejected reload +
+replica kill) replays byte-identically across same-seed runs, and the
+operator surfaces (`elasticdl top` / `elasticdl slo`) render the online
+line and stream-lag coverage from the snapshot."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.client.slo import render_slo
+from elasticdl_tpu.client.top import render as top_render
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.serving.server import make_predict_request
+from model_zoo.clickstream import ctr_mlp
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_model_spec(
+        "model_zoo", "clickstream.ctr_mlp.custom_model"
+    )
+
+
+@pytest.fixture(scope="module")
+def loop_result(spec, tmp_path_factory):
+    """One un-faulted pass under a fake clock: 8 ticks (one 64-record
+    window each), two live predicts between ticks, checkpoint every 2
+    windows -> at least two hot-reload cycles behind traffic."""
+    clk = [1_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    cfg = OnlineConfig(
+        seed=5, window_records=64, records_per_poll=64,
+        records_per_task=16, checkpoint_every_windows=2, replicas=2,
+    )
+    tmp = tmp_path_factory.mktemp("online_loop")
+    pipe = OnlinePipeline(str(tmp), spec, cfg, clock=clock)
+    rng = np.random.RandomState(5)
+    served = failed = 0
+    for _ in range(8):
+        pipe.tick()
+        for _ in range(2):
+            x = ctr_mlp.encode(
+                rng.randint(0, cfg.source_users, 2),
+                rng.randint(0, cfg.source_items, 2),
+            )
+            try:
+                resp = pipe.predict(make_predict_request(x))
+                ok = resp.code == spb.SERVING_OK
+            except Exception:
+                ok = False
+            if ok:
+                served += 1
+            else:
+                failed += 1
+    snap = pipe.snapshot()
+    pipe.shutdown()
+    return {"snap": snap, "served": served, "failed": failed}
+
+
+def test_loop_trains_windows_and_checkpoints(loop_result):
+    snap = loop_result["snap"]
+    assert snap["windows_trained"] >= 4
+    assert snap["examples_trained"] >= snap["windows_trained"] * 64
+    assert snap["model_step"] > 0
+    assert snap["latest_saved_step"] > 0
+    assert snap["tasks"]["counters"]["failed"] == 0
+    online = snap["online"]
+    assert online["windows_armed"] == snap["stream"]["windows_sealed"]
+    assert online["rearm_faults"] == 0
+    assert snap["stream"]["dropped_windows"] == 0
+
+
+def test_loop_hot_reloads_behind_live_traffic(loop_result):
+    """The acceptance bar: >= 2 distinct checkpoint->hot-reload cycles
+    completed while predicts kept flowing, zero failed."""
+    snap = loop_result["snap"]
+    fleet = snap["serving_fleet"]
+    cycles = {
+        d["target_step"] for d in fleet["decisions"]
+        if d.get("action") == "reload_step"
+    }
+    assert len(cycles) >= 2
+    assert fleet["reload_steps"] >= 2          # per-replica swap count
+    assert snap["online"]["last_reload_step"] > 0
+    assert loop_result["failed"] == 0
+    assert loop_result["served"] == 16
+
+
+def test_loop_measures_staleness_and_stream_lag(loop_result):
+    snap = loop_result["snap"]
+    fresh = snap["freshness"]
+    assert fresh["observations"] == loop_result["served"]
+    assert fresh["staleness_p99_s"] >= 0.0
+    slo = snap["slo"]
+    assert slo["history"]["stream_lag_samples"] > 0
+    # un-faulted loop on a fake clock: the staleness SLO never burns
+    assert snap["max_burn"] == 0.0
+
+
+def test_chaos_replay_is_byte_identical():
+    """Same-seed chaos runs — stream.poll stall, task.rearm loss,
+    serving.reload rejection, mid-run replica kill — produce identical
+    fault traces, fleet/SLO decision lists, and event streams, with all
+    scheduled faults fired and zero failed predicts (docs/ONLINE.md
+    "Determinism under chaos")."""
+    import bench
+
+    trace_a, summary_a = bench._online_chaos_run(17)
+    trace_b, summary_b = bench._online_chaos_run(17)
+    assert trace_a == trace_b
+    assert summary_a["all_faults_fired"]
+    assert summary_a["failed_requests"] == 0
+    assert summary_b["failed_requests"] == 0
+    assert summary_a["rearm_faults"] == 1
+    assert summary_a["poll_faults"] == 1
+    assert summary_a["windows_trained"] >= 2
+
+
+def test_top_renders_online_line(loop_result):
+    snap = loop_result["snap"]
+    frame = top_render({"snapshot": {
+        "tasks": snap["tasks"],
+        "online": snap["online"],
+        "serving_fleet": snap["serving_fleet"],
+        "freshness": snap["freshness"],
+    }})
+    (line,) = [l for l in frame.splitlines() if l.startswith("online:")]
+    online = snap["online"]
+    assert f"window={online['window']}" in line
+    assert f"armed={online['windows_armed']}" in line
+    assert f"last_reload_step={online['last_reload_step']}" in line
+    # batch jobs (no online section) render no online line
+    batch = top_render({"snapshot": {"tasks": snap["tasks"]}})
+    assert "online:" not in batch
+
+
+def test_slo_report_covers_stream_lag(loop_result):
+    report = render_slo(loop_result["snap"]["slo"])
+    assert "stream lag:" in report
+    assert "master_stream_watermark_lag_seconds" in report
+    # batch history (no annotation) renders no stream-lag line
+    slo = dict(loop_result["snap"]["slo"])
+    slo["history"] = {
+        k: v for k, v in slo["history"].items()
+        if k != "stream_lag_samples"
+    }
+    assert "stream lag:" not in render_slo(slo)
+
+
+def test_online_summary_matches_script():
+    """The ONLINE_SUMMARY CI line and this suite assert on the same
+    compute (scripts/online_summary.py `smoke_summary`)."""
+    from scripts.online_summary import smoke_summary
+
+    summary = smoke_summary(windows=1)
+    assert summary["failed_requests"] == 0
+    assert summary["windows_trained"] >= 1
+    assert summary["train_eps"] > 0
+    assert summary["qps"] > 0
+    assert summary["staleness_p99_s"] >= 0.0

@@ -100,6 +100,62 @@ def test_sticky_packer_keeps_shapes_and_round_trips():
     assert len(shapes) == 1
 
 
+# ---- packer ranking == store admission signal -----------------------------
+
+
+def test_packer_ranking_matches_frequency_rank():
+    """`DedupPacker.last_ranking` IS `frequency_rank` of the same flat
+    batch — values, order, AND tie-breaks — across both ranking paths
+    (bincount LUT and the huge-range np.unique fallback), and asking for
+    the ranking changes no wire bytes.  The tiered store admits on this
+    signal (HotRowCache.plan `ranked=`), so drift here would silently
+    change which rows the cache pins."""
+    from elasticdl_tpu.data.wire import frequency_rank
+
+    packer = DedupPacker()
+    for seed, big in [(0, False), (1, False), (2, True)]:
+        rng = np.random.RandomState(40 + seed)
+        if big:
+            # id range past the bincount budget: np.unique fallback
+            rows = rng.randint(0, 1 << 28, size=(257, 26)).astype(np.int64)
+        else:
+            rows = _zipf_rows(rng, 2048, 26)
+        packed = packer.pack(rows)
+        uniq, counts = packer.last_ranking
+        exp_uniq, exp_counts = frequency_rank(rows.reshape(-1))
+        np.testing.assert_array_equal(uniq, exp_uniq)
+        np.testing.assert_array_equal(counts, exp_counts)
+        assert int(counts.sum()) == rows.size
+        # the ranking rides along without perturbing the wire struct
+        assert is_packed_dedup(packed)
+        np.testing.assert_array_equal(_unpack(packed), rows)
+
+
+def test_field_disjoint_ids_is_a_per_field_bijection():
+    """The store-admission encoding (`id * F + field`): raw ids that
+    collide across fields encode to distinct values, the encoding is
+    invertible, and malformed inputs are rejected."""
+    from elasticdl_tpu.data.wire import field_disjoint_ids
+
+    rng = np.random.RandomState(9)
+    sparse = rng.randint(0, 1000, size=(64, 26)).astype(np.int32)
+    enc = field_disjoint_ids(sparse)
+    assert enc.dtype == np.int64 and enc.shape == sparse.shape
+    np.testing.assert_array_equal(enc // 26, sparse)
+    np.testing.assert_array_equal(
+        enc % 26, np.broadcast_to(np.arange(26), sparse.shape)
+    )
+    # same raw id, different fields -> different encoded values
+    same = np.full((4, 26), 7, np.int32)
+    assert len(np.unique(field_disjoint_ids(same))) == 26
+    with pytest.raises(ValueError):
+        field_disjoint_ids(np.arange(4))
+    with pytest.raises(ValueError):
+        field_disjoint_ids(
+            np.full((1, 26), np.iinfo(np.int64).max // 2, np.int64)
+        )
+
+
 # ---- arena vs per-feature numerical identity ------------------------------
 
 

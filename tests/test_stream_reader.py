@@ -1,0 +1,162 @@
+"""Stream reader unit coverage: seeded source determinism, window
+sealing + watermark accounting, bounded-buffer drop policy, the
+shard-addressable read contract, and the `stream.poll` fault point
+(docs/ONLINE.md "The stream side", docs/ROBUSTNESS.md)."""
+
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.data.reader.stream_reader import (
+    ClickStreamSource,
+    StreamReader,
+)
+from elasticdl_tpu.master.task_manager import TaskManager
+
+
+class FakeClock:
+    def __init__(self, start=1_000.0, step=1.0):
+        self.now = start
+        self.step = step
+
+    def __call__(self):
+        self.now += self.step
+        return self.now
+
+
+def make_reader(window_records=8, max_buffered=64, clock=None,
+                records_per_poll=8, seed=7):
+    clock = clock or FakeClock()
+    source = ClickStreamSource(
+        seed=seed, users=32, items=16,
+        records_per_poll=records_per_poll, clock=clock,
+    )
+    return StreamReader(
+        source, window_records=window_records,
+        max_buffered_windows=max_buffered, clock=clock,
+    ), clock
+
+
+def test_source_content_is_clock_independent():
+    """Record content is a function of (seed, index) only — the clock
+    merely stamps event_unix_s — so same-seed runs under different
+    clocks train on identical data."""
+    a = ClickStreamSource(seed=3, clock=FakeClock(0.0, 1.0))
+    b = ClickStreamSource(seed=3, clock=FakeClock(9_999.0, 0.25))
+    ra, rb = a.poll(32), b.poll(32)
+    strip = lambda rs: [
+        {k: r[k] for k in ("user", "item", "clicked")} for r in rs
+    ]
+    assert strip(ra) == strip(rb)
+    assert ClickStreamSource(seed=4).poll(32) is not None  # different seed ok
+
+
+def test_windows_seal_at_bound_and_emit_event():
+    reader, _ = make_reader(window_records=8, records_per_poll=5)
+    seen = []
+    observe = lambda record: seen.append(record)
+    events.add_observer(observe)
+    try:
+        assert reader.poll() == 5          # 5 buffered, nothing sealed
+        assert reader.take_new_windows() == []
+        assert reader.poll() == 5          # 10 total -> one window of 8
+    finally:
+        events.remove_observer(observe)
+    windows = reader.take_new_windows()
+    assert [len(w.records) for w in windows] == [8]
+    assert windows[0].name == "stream:w000000"
+    sealed = [r for r in seen if r.get("event") == "stream_window_sealed"]
+    assert sealed and sealed[0]["records"] == 8
+    snap = reader.snapshot()
+    assert snap["windows_sealed"] == 1
+    assert snap["pending_records"] == 2
+    assert snap["records"] == 10
+
+
+def test_watermark_and_lag_track_newest_sealed_event():
+    clock = FakeClock(100.0, 1.0)
+    reader, _ = make_reader(window_records=4, records_per_poll=4,
+                            clock=clock)
+    assert reader.lag_s() == 0.0           # no sealed window yet
+    reader.poll()
+    (window,) = reader.take_new_windows()
+    assert window.watermark_unix_s == reader.watermark_unix_s
+    lag = reader.lag_s()               # advances the fake clock one step
+    assert lag == pytest.approx(clock.now - window.watermark_unix_s)
+
+
+def test_buffer_cap_drops_oldest_window():
+    reader, _ = make_reader(window_records=4, max_buffered=2,
+                            records_per_poll=4)
+    for _ in range(3):                     # 3 sealed > cap of 2
+        reader.poll()
+    snap = reader.snapshot()
+    assert snap["dropped_windows"] == 1
+    assert snap["buffered_windows"] == 2
+    names = {name for name, _, _ in reader.create_shards()}
+    assert "stream:w000000" not in names   # oldest evicted
+    # the dropped window is gone from the unclaimed hand-off too
+    assert {w.name for w in reader.take_new_windows()} == names
+
+
+def test_read_records_serves_leased_tasks_then_raises_after_release():
+    reader, _ = make_reader(window_records=8, records_per_poll=8)
+    reader.poll()
+    (window,) = reader.take_new_windows()
+    tm = TaskManager(perpetual=True)
+    n = tm.arm_window(window.name, len(window.records), 3)
+    assert n == 3                          # 8 records / 3 per task
+    got = []
+    for _ in range(n):
+        task = tm.get(0)
+        got.extend(reader.read_records(task))
+        tm.report(task.task_id, True, worker_id=0, records=3)
+    assert got == window.records
+    reader.release_window(window.name)
+    task = type("T", (), {"shard": type("S", (), {
+        "name": window.name, "start": 0, "end": 8})()})()
+    with pytest.raises(LookupError):
+        list(reader.read_records(task))
+
+
+def test_poll_fault_stalls_without_losing_records():
+    """An injected stream.poll raise skips the pull; the source
+    re-delivers on the next poll, so the fault reads as lag, not loss."""
+    reader, _ = make_reader(window_records=4, records_per_poll=4)
+    faults.install(FaultRegistry(schedule=[
+        FaultSpec(faults.POINT_STREAM_POLL, 0, "raise"),
+    ], seed=11))
+    try:
+        assert reader.poll() == 0          # stalled
+        assert reader.poll() == 4          # re-delivered
+    finally:
+        faults.uninstall()
+    snap = reader.snapshot()
+    assert snap["poll_faults"] == 1
+    assert snap["polls"] == 2
+    assert snap["records"] == 4
+
+
+def test_rearm_fault_arms_nothing_atomically():
+    tm = TaskManager(perpetual=True)
+    faults.install(FaultRegistry(schedule=[
+        FaultSpec(faults.POINT_TASK_REARM, 0, "raise"),
+    ], seed=12))
+    try:
+        assert tm.arm_window("stream:w000000", 8, 4) is None
+    finally:
+        faults.uninstall()
+    assert tm.get(0) is None               # no partial enqueue
+    snap = tm.online_snapshot()
+    assert snap["rearm_faults"] == 1
+    assert snap["windows_armed"] == 0
+    # the retry succeeds and revives the queue
+    assert tm.arm_window("stream:w000000", 8, 4, window_id=0) == 2
+    assert tm.online_snapshot()["windows_armed"] == 1
+    assert tm.get(0) is not None
+
+
+def test_arm_window_requires_perpetual_mode():
+    with pytest.raises(RuntimeError):
+        TaskManager().arm_window("w", 8, 4)
+    assert TaskManager().online_snapshot() is None

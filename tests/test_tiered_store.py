@@ -143,6 +143,120 @@ def test_cache_state_arrays_round_trip():
     assert p.misses == 0 and p.hits == 2
 
 
+def test_cache_rejects_ranking_with_wrong_coverage():
+    cache = HotRowCache(8)
+    with pytest.raises(ValueError, match="lookups"):
+        cache.plan(
+            np.array([1, 1, 2], np.int64),
+            ranked=(np.array([1, 2], np.int64), np.array([1, 1], np.int64)),
+        )
+
+
+def test_cache_ranked_plan_matches_unranked_twin():
+    """Feeding the wire's precomputed ranking must be a pure optimisation:
+    every plan field and the post-plan cache state stay identical to a
+    twin cache that re-derives the ranking itself."""
+    from elasticdl_tpu.data.wire import frequency_rank
+
+    ranked_c, plain_c = HotRowCache(64), HotRowCache(64)
+    rng = np.random.RandomState(21)
+    for _ in range(6):
+        rows = (rng.zipf(1.3, size=(64,)) % 40).astype(np.int64)
+        a = ranked_c.plan(rows, ranked=frequency_rank(rows))
+        b = plain_c.plan(rows)
+        np.testing.assert_array_equal(a.slots, b.slots)
+        np.testing.assert_array_equal(a.admit_rows, b.admit_rows)
+        np.testing.assert_array_equal(a.admit_slots, b.admit_slots)
+        np.testing.assert_array_equal(a.evict_rows, b.evict_rows)
+        np.testing.assert_array_equal(a.evict_slots, b.evict_slots)
+        assert (a.hits, a.misses) == (b.hits, b.misses)
+    for x, y in zip(ranked_c.state_arrays(), plain_c.state_arrays()):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---- wire-ranked admission through the store ---------------------------
+
+
+def _twin_stores(cache_rows=256):
+    mk = lambda: TieredStore(
+        {"fm_embedding": 4, "fm_linear": 1}, NUM_FIELDS, cache_rows
+    )
+    return mk(), mk()
+
+
+def test_store_ranked_prepare_matches_unranked_twin():
+    """The full producer contract: DedupPacker over
+    `wire.field_disjoint_ids(sparse)` fed to `prepare(ranked=)` plans
+    byte-identically to a twin store that re-ranks internally — on
+    batches whose raw ids collide across fields (the per-field-vocab
+    case a raw-id ranking would silently mistranslate)."""
+    from elasticdl_tpu.data.wire import DedupPacker, field_disjoint_ids
+
+    ranked_s, plain_s = _twin_stores()
+    packer = DedupPacker()
+    rng = np.random.RandomState(13)
+    for _ in range(4):
+        # ids 0..4 in every field: heavy cross-field raw-id collisions
+        sparse = rng.randint(0, 5, size=(4, NUM_FIELDS)).astype(np.int64)
+        packer.pack(field_disjoint_ids(sparse))
+        slots_a, plan_a = ranked_s.prepare(
+            sparse, ranked=packer.last_ranking
+        )
+        slots_b, plan_b = plain_s.prepare(sparse)
+        np.testing.assert_array_equal(slots_a, slots_b)
+        np.testing.assert_array_equal(plan_a.admit_rows, plan_b.admit_rows)
+        np.testing.assert_array_equal(plan_a.evict_rows, plan_b.evict_rows)
+        assert (plan_a.hits, plan_a.misses) == (plan_b.hits, plan_b.misses)
+    assert ranked_s.host.size == plain_s.host.size
+    for x, y in zip(
+        ranked_s.cache.state_arrays(), plain_s.cache.state_arrays()
+    ):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_store_attach_consumes_dedup_ranking_key():
+    """`attach` pops `__dedup_ranking__` (never shipped to the trainer)
+    and produces the same slots as an unranked twin."""
+    from elasticdl_tpu.data.wire import DedupPacker, field_disjoint_ids
+
+    ranked_s, plain_s = _twin_stores()
+    rng = np.random.RandomState(14)
+    sparse = rng.randint(0, 5, size=(4, NUM_FIELDS)).astype(np.int64)
+    packer = DedupPacker()
+    packer.pack(field_disjoint_ids(sparse))
+    batch = {
+        "features": {"dense": np.zeros((4, 13), np.float32),
+                     "sparse": sparse},
+        "labels": np.zeros(4, np.int32),
+        "__dedup_ranking__": packer.last_ranking,
+    }
+    out = ranked_s.attach(batch)
+    assert "__dedup_ranking__" not in out
+    assert "sparse" not in out["features"]
+    twin = plain_s.attach({
+        "features": {"dense": np.zeros((4, 13), np.float32),
+                     "sparse": sparse},
+        "labels": np.zeros(4, np.int32),
+    })
+    np.testing.assert_array_equal(
+        out["features"]["slots"], twin["features"]["slots"]
+    )
+
+
+def test_store_rejects_raw_id_ranking():
+    """A ranking over RAW per-field ids (the encoding the per-field
+    vocabulary makes unsound) is refused loudly instead of silently
+    mistranslating cache slots."""
+    from elasticdl_tpu.data.wire import frequency_rank
+
+    store, _ = _twin_stores()
+    sparse = np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 100
+    with pytest.raises(ValueError, match="field_disjoint_ids"):
+        store.prepare(
+            sparse, ranked=frequency_rank(sparse.reshape(-1))
+        )
+
+
 # ---- host tier ---------------------------------------------------------
 
 
@@ -272,6 +386,35 @@ def test_sidecar_round_trip_and_latest_row_values(tmp_path):
     for r in range(store.host.size):
         want = float(id_of_row[r]) + (1.0 if r in resident_rows else 0.0)
         np.testing.assert_array_equal(latest[r], np.full(DIM, want))
+
+
+def test_keep_max_prunes_sidecars_in_lockstep(tmp_path):
+    """Keep-last-K rotates `.tiered/<step>/` sidecars together with the
+    orbax step dirs and their manifests — a surviving step always has
+    its sidecar, a rotated step never leaves one behind (docs/ONLINE.md
+    "Checkpoints: cadence, keep-last-K, pinning")."""
+    from elasticdl_tpu.common.save_utils import CheckpointSaver
+
+    store, state, _ = _driven_store(perturb=0.0)
+    ckpt = str(tmp_path / "ckpt")
+    saver = CheckpointSaver(ckpt, keep_max=2, async_save=False)
+    saver.attach_tiered_store(store)
+    for i in range(1, 5):
+        assert saver.save(
+            state.replace(step=jnp.asarray(i, jnp.int32)), force=True
+        )
+    saver.wait_until_finished()
+    assert set(saver._mngr.all_steps()) == {3, 4}
+    for step in (1, 2):
+        assert not store_ckpt.has_sidecar(ckpt, step)
+    for step in (3, 4):
+        assert store_ckpt.has_sidecar(ckpt, step)
+    leftover = {
+        n for n in os.listdir(os.path.join(ckpt, store_ckpt.SIDECAR_ROOT))
+        if n.isdigit()
+    }
+    assert leftover == {"3", "4"}
+    saver.close()
 
 
 def test_migration_tiered_to_flat_and_back(tmp_path):
