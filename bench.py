@@ -1186,11 +1186,15 @@ def _online_chaos_run(seed: int):
     """One seeded chaos pass of the online loop under a FAKE clock and a
     strictly sequential driver: a stream stall (`stream.poll`), a lost
     window re-arm (`task.rearm`), a rejected hot-reload
-    (`serving.reload`), and a mid-run replica kill all land mid-loop.
-    Returns (canonical_text, summary): the text concatenates the fault
-    trace, the fleet manager's and SLO evaluator's clock-free decision
-    lists, and the normalized span-event stream — byte-identical across
-    same-seed runs (the acceptance bar of docs/ONLINE.md)."""
+    (`serving.reload`), a deferred shard move (`store.shard_handoff`),
+    a mid-run replica kill, TWO trainer-worker kills (the second retries
+    the deferred shard move), and a master restart landed while a window
+    is mid-flight.  Returns (canonical_text, summary): the text
+    concatenates the fault trace, the fleet manager's and SLO
+    evaluator's clock-free decision lists, and the normalized span-event
+    stream — byte-identical across same-seed runs (the acceptance bar
+    of docs/ONLINE.md).  The exactly-once claim is checked in summary:
+    zero lost windows, zero duplicate shard reports."""
     import tempfile
 
     from elasticdl_tpu.common import events as events_lib
@@ -1216,10 +1220,14 @@ def _online_chaos_run(seed: int):
             FaultSpec(faults.POINT_STREAM_POLL, 2, "raise"),
             FaultSpec(faults.POINT_TASK_REARM, 3, "raise"),
             FaultSpec(faults.POINT_SERVING_RELOAD, 2, "raise"),
+            # first handoff attempt (trainer 2's shard) defers; the
+            # second kill's evacuation retries and completes it
+            FaultSpec(faults.POINT_STORE_SHARD_HANDOFF, 1, "raise"),
         ],
         seed=seed,
     ))
-    keep = ("window", "tasks", "records", "step")
+    keep = ("window", "tasks", "records", "step",
+            "shard", "from_worker", "to_worker")
     norm_events = []
 
     def observe(record):
@@ -1239,15 +1247,42 @@ def _online_chaos_run(seed: int):
                 OnlineConfig(
                     seed=seed, window_records=64, records_per_poll=64,
                     records_per_task=16, checkpoint_every_windows=2,
-                    replicas=2,
+                    replicas=2, workers=3, num_shards=4,
                 ),
                 clock=clock,
             )
             for i in range(12):
-                pipe.tick()
+                if i == 7:
+                    # leave the tick's window mid-flight (1 of its 4
+                    # shards trained), then kill the master brain: the
+                    # replacement must re-arm exactly the 3 undone
+                    # shards from the journal
+                    pipe.tick(max_train_tasks=1)
+                    restored = pipe.restart_master()
+                    faults.note(
+                        "master.restart",
+                        "windows=%d tasks=%d" % (
+                            restored["windows_restored"],
+                            restored["tasks_rearmed"],
+                        ),
+                    )
+                else:
+                    pipe.tick()
                 if i == 3:
                     pipe.kill_replica(1)
                     faults.note("replica.kill", "replica=1")
+                if i == 4:
+                    info = pipe.kill_worker(2)
+                    faults.note(
+                        "trainer.kill",
+                        "worker=2 handoffs=%d" % info["handoffs"],
+                    )
+                if i == 9:
+                    info = pipe.kill_worker(1)
+                    faults.note(
+                        "trainer.kill",
+                        "worker=1 handoffs=%d" % info["handoffs"],
+                    )
                 for _ in range(2):
                     x = ctr_mlp.encode(
                         rng.randint(0, 512, 2), rng.randint(0, 128, 2)
@@ -1258,6 +1293,8 @@ def _online_chaos_run(seed: int):
                             failed += 1
                     except Exception:
                         failed += 1
+            # drain the restart's re-armed remainder before snapshotting
+            pipe.tick()
             snap = pipe.snapshot()
             pipe.shutdown()
     finally:
@@ -1277,6 +1314,15 @@ def _online_chaos_run(seed: int):
         "poll_faults": snap["stream"]["poll_faults"],
         "last_reload_step": snap["online"]["last_reload_step"],
         "windows_trained": snap["windows_trained"],
+        "handoffs": snap["online"]["handoffs"],
+        "pending_handoffs": snap["online"]["pending_handoffs"],
+        "handoff_faults": snap["store"]["handoff_faults"],
+        "windows_released": snap["online"]["windows_released"],
+        "windows_lost": snap["online"]["windows_lost"],
+        "duplicate_reports": snap["online"]["duplicate_reports"],
+        "master_restarts": snap["online"]["master_restarts"],
+        "alive_trainers": snap["online"]["alive_trainers"],
+        "replayed_windows": snap["stream"]["replayed_windows"],
     }
     return canonical, summary
 
@@ -1298,9 +1344,11 @@ def bench_online(
     checkpoint->hot-reload cycles completed behind live traffic (must
     be >= 2), and the failed-request count (must be 0).  The chaos
     variant runs twice with the same seed under a fake clock — stream
-    stall + window re-arm loss + rejected reload + replica kill — and
-    asserts the fault trace / fleet decisions / SLO decisions / event
-    stream compare byte-identical."""
+    stall + window re-arm loss + rejected reload + replica kill + two
+    trainer kills (shard handoff, one move fault-deferred then retried)
+    + a mid-flight master restart — and asserts the fault trace / fleet
+    decisions / SLO decisions / event stream compare byte-identical,
+    with zero lost windows and zero duplicate shard reports."""
     import tempfile
     import threading
     import time

@@ -166,12 +166,6 @@ def _train_local(args, job_type: str = "train") -> int:
     tiered_store = None
     build_tiered_store = getattr(spec.module, "build_tiered_store", None)
     if build_tiered_store is not None and job_type == "train":
-        if args.num_workers != 1:
-            raise ValueError(
-                "tiered embedding store requires --num_workers 1: cache "
-                "admission plans must be prepared and applied in strict "
-                "batch order by one producer/consumer pair"
-            )
         if getattr(args, "steps_per_execution", 1) != 1:
             raise ValueError(
                 "tiered embedding store requires --steps_per_execution 1:"
@@ -196,6 +190,19 @@ def _train_local(args, job_type: str = "train") -> int:
             registry=metrics_lib.default_registry(),
             phase_timer=_phase_timer,
         )
+        if args.num_workers != 1:
+            # Multi-worker path: N feed producers cannot keep the strict
+            # batch-order invariant eager planning needs, so planning is
+            # DEFERRED to the trainer's step-serialized critical section
+            # (ModelOwner's lock) — prepare+apply run in step order there
+            # regardless of producer interleaving.  Costs the async
+            # cold-gather overlap; see docs/PERF.md §4.  Row-range
+            # sharding across workers is store/sharding.py.
+            tiered_store.enable_deferred_prepare()
+            logger.info(
+                "Tiered store: deferred planning for %d workers",
+                args.num_workers,
+            )
         spec.feed = tiered_store.wrap_feed(spec.feed)
         spec.feed_bulk = tiered_store.wrap_feed(spec.feed_bulk)
         owner.trainer.tiered_store = tiered_store

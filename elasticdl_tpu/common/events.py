@@ -54,6 +54,10 @@ STORE_GROWN = "store_grown"        # tiered store lazily grew vocab rows
 STORE_TIER_SWAPPED = "store_tier_swapped"  # serving adopted tier metadata
 STREAM_WINDOW_SEALED = "stream_window_sealed"  # a stream window filled
 STREAM_WINDOW_ARMED = "stream_window_armed"    # window became queue tasks
+STREAM_WINDOW_DROPPED = "stream_window_dropped"  # bounded buffer lost one
+STREAM_WINDOW_RELEASED = "stream_window_released"  # ledger acked trained
+STREAM_WINDOW_RESTORED = "stream_window_restored"  # un-acked replayed
+STORE_SHARD_HANDOFF = "store_shard_handoff"  # row range moved to successor
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -66,7 +70,8 @@ VOCABULARY = frozenset({
     POLICY_DECISION, SERVING_REPLICA_RELAUNCHED, FLEET_RELOAD_STEP,
     FLEET_RELOAD_REFUSED, SLO_BREACH, SLO_RECOVERED, PREDICT_SPAN,
     INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
-    STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED,
+    STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED, STREAM_WINDOW_DROPPED,
+    STREAM_WINDOW_RELEASED, STREAM_WINDOW_RESTORED, STORE_SHARD_HANDOFF,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every
@@ -75,7 +80,9 @@ VOCABULARY = frozenset({
 #: a decision an operator cannot grep for by exact name is a decision
 #: that never reached the dashboards.
 POLICY_ACTIONS = frozenset({"evict", "scale_up", "scale_down"})
-POLICY_REASONS = frozenset({"straggler", "backlog", "data_wait"})
+POLICY_REASONS = frozenset({
+    "straggler", "backlog", "data_wait", "stream_lag",
+})
 
 #: Closed vocabularies for the serve-path PREDICT_SPAN event
 #: (docs/OBSERVABILITY.md "Request tracing & incident bundles").
@@ -99,7 +106,7 @@ SPAN_REASONS = frozenset({
 #: manifest draws from this set.
 INCIDENT_TRIGGERS = frozenset({
     "slo_breach", "policy_eviction", "reload_refused", "manual",
-    "tier1_failure",
+    "tier1_failure", "window_dropped",
 })
 
 _lock = threading.Lock()

@@ -59,6 +59,11 @@ POINT_FLEET_RELOAD_STEP = "fleet.reload_step"
 # reaching training without anything crashing.
 POINT_STREAM_POLL = "stream.poll"
 POINT_TASK_REARM = "task.rearm"
+# Sharded-store boundary (store/sharding.py): the master reassigns a dead
+# or evicted worker's row range to a successor; a handoff that errors
+# mid-move leaves the shard orphaned until the next retry — exactly the
+# window the chaos soak aims at.
+POINT_STORE_SHARD_HANDOFF = "store.shard_handoff"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -77,6 +82,7 @@ POINTS = (
     POINT_FLEET_RELOAD_STEP,
     POINT_STREAM_POLL,
     POINT_TASK_REARM,
+    POINT_STORE_SHARD_HANDOFF,
 )
 
 ACTIONS = ("raise", "delay", "drop")

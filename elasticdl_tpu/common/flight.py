@@ -163,6 +163,14 @@ class FlightRecorder:
                     ("reload_refused", record.get("pending_step")),
                     record,
                 )
+            elif event == events.STREAM_WINDOW_DROPPED:
+                # a silently lost training window is an incident, not a
+                # log line: bundle the rings around the drop
+                self._pend_locked(
+                    "window_dropped",
+                    ("window_dropped", record.get("window")),
+                    record,
+                )
 
     def _pend_locked(self, trigger: str, key: tuple,
                      evidence: dict) -> None:

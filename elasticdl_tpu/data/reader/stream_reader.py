@@ -25,8 +25,13 @@ Backpressure: sealed windows wait in a bounded buffer
 (`max_buffered_windows`).  The pipeline releases each window after
 training it; if training falls so far behind that the buffer fills, the
 OLDEST window is dropped (counted — `data_stream_windows_dropped_total`
-should stay 0 in a healthy deployment) rather than growing host memory
-without bound.
+should stay 0 in a healthy deployment — and announced with a
+`stream_window_dropped` span event that triggers a flight-recorder
+incident bundle) rather than growing host memory without bound.  A drop
+is not necessarily a loss: because source content is a pure function of
+(seed, record index), `restore_window` regenerates any un-acked
+window's exact records on demand, which is how a restarted master
+replays the windows its ledger says were never fully trained.
 """
 
 from __future__ import annotations
@@ -46,14 +51,29 @@ from elasticdl_tpu.data.reader.base import AbstractDataReader
 logger = get_logger(__name__)
 
 
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 — the same per-index
+    hash discipline store/host_tier.py uses for row init.  uint64
+    wraparound is the algorithm (mod-2^64 multiply), not an accident —
+    mute numpy's scalar-overflow warning for it."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
 class ClickStreamSource:
     """Seeded synthetic click-stream: (user, item, clicked) impressions.
 
-    Record content is a pure function of (seed, record index) — the
-    clock only stamps `event_unix_s` — so two same-seed runs produce
-    identical feature/label sequences regardless of wall time.  Clicks
-    follow a stable per-(user, item) affinity (a seeded hash), giving
-    the online model a learnable signal rather than label noise.
+    Record content is a pure function of (seed, record index) — record
+    `i` of the stream is ALWAYS the same impression, computed by hashing
+    the index, never by advancing a shared rng — so any record range can
+    be regenerated on demand (`records(start, n)`).  That replayability
+    is what lets a restarted master re-buffer un-acked windows instead
+    of dropping them blind.  The clock only stamps `event_unix_s`.
+    Clicks follow a stable per-(user, item) affinity, giving the online
+    model a learnable signal rather than label noise.
     """
 
     def __init__(
@@ -68,12 +88,45 @@ class ClickStreamSource:
         self.items = int(items)
         self.records_per_poll = int(records_per_poll)
         self._clock = clock
-        self._rng = np.random.default_rng(int(seed) & 0xFFFFFFFF)
+        rng = np.random.default_rng(int(seed) & 0xFFFFFFFF)
         # Per-user and per-item propensities drawn once: clicked ~
         # Bernoulli(sigmoid(u_bias + i_bias)), deterministic given seed.
-        self._user_bias = self._rng.normal(0.0, 1.0, self.users)
-        self._item_bias = self._rng.normal(0.0, 1.0, self.items)
+        self._user_bias = rng.normal(0.0, 1.0, self.users)
+        self._item_bias = rng.normal(0.0, 1.0, self.items)
+        # Per-field salts keyed off the seed so user/item/click draws at
+        # one index are independent streams.
+        base = _mix64(np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+        self._salts = tuple(
+            _mix64(base ^ np.uint64(k)) for k in (1, 2, 3)
+        )
         self.emitted = 0
+
+    def records(self, start: int, n: int,
+                event_unix_s: float = 0.0) -> List[dict]:
+        """Records [start, start+n) of the stream — pure function of
+        (seed, index), so replaying a lost window regenerates its exact
+        training content."""
+        if n <= 0:
+            return []
+        idx = np.arange(start, start + n, dtype=np.uint64)
+        users = _mix64(idx ^ self._salts[0]) % np.uint64(self.users)
+        items = _mix64(idx ^ self._salts[1]) % np.uint64(self.items)
+        logits = self._user_bias[users] + self._item_bias[items]
+        prob = 1.0 / (1.0 + np.exp(-logits))
+        uniform = (
+            (_mix64(idx ^ self._salts[2]) >> np.uint64(11)).astype(np.float64)
+            * (2.0 ** -53)
+        )
+        clicked = (uniform < prob).astype(np.int64)
+        return [
+            {
+                "user": int(users[i]),
+                "item": int(items[i]),
+                "clicked": int(clicked[i]),
+                "event_unix_s": float(event_unix_s),
+            }
+            for i in range(n)
+        ]
 
     def poll(self, max_records: Optional[int] = None) -> List[dict]:
         """Next batch of impressions, event-stamped at the current
@@ -81,36 +134,29 @@ class ClickStreamSource:
         n = self.records_per_poll if max_records is None else int(max_records)
         if n <= 0:
             return []
-        now = float(self._clock())
-        users = self._rng.integers(0, self.users, n)
-        items = self._rng.integers(0, self.items, n)
-        logits = self._user_bias[users] + self._item_bias[items]
-        prob = 1.0 / (1.0 + np.exp(-logits))
-        clicked = (self._rng.random(n) < prob).astype(np.int64)
-        records = [
-            {
-                "user": int(users[i]),
-                "item": int(items[i]),
-                "clicked": int(clicked[i]),
-                "event_unix_s": now,
-            }
-            for i in range(n)
-        ]
+        records = self.records(self.emitted, n,
+                               event_unix_s=float(self._clock()))
         self.emitted += n
         return records
 
 
 class StreamWindow:
-    """One sealed window: a finite, immutable slice of the stream."""
+    """One sealed window: a finite, immutable slice of the stream.
+    `start_index` is the absolute stream offset of its first record —
+    the replay coordinate a restarted master hands back to
+    `StreamReader.restore_window`."""
 
-    __slots__ = ("name", "window_id", "records", "watermark_unix_s")
+    __slots__ = (
+        "name", "window_id", "records", "watermark_unix_s", "start_index",
+    )
 
     def __init__(self, name: str, window_id: int, records: List[dict],
-                 watermark_unix_s: float):
+                 watermark_unix_s: float, start_index: int = 0):
         self.name = name
         self.window_id = window_id
         self.records = records
         self.watermark_unix_s = watermark_unix_s
+        self.start_index = start_index
 
 
 class StreamReader(AbstractDataReader):
@@ -164,6 +210,10 @@ class StreamReader(AbstractDataReader):
             "data_stream_windows_dropped_total",
             "sealed windows evicted past the buffer cap",
         )
+        self._replayed_total = self.metrics_registry.counter(
+            "data_stream_windows_replayed_total",
+            "un-acked windows regenerated from the replayable source",
+        )
         self.metrics_registry.gauge_fn(
             "data_stream_watermark_lag_seconds",
             self.lag_s,
@@ -193,12 +243,13 @@ class StreamReader(AbstractDataReader):
         if not records:
             return 0
         sealed: List[StreamWindow] = []
+        dropped: List[StreamWindow] = []
         with self._lock:
             self._current.extend(records)
             while len(self._current) >= self._window_records:
                 chunk = self._current[: self._window_records]
                 self._current = self._current[self._window_records:]
-                sealed.append(self._seal_locked(chunk))
+                sealed.append(self._seal_locked(chunk, dropped))
         self._records.inc(len(records))
         for window in sealed:
             self._sealed_total.inc()
@@ -207,9 +258,19 @@ class StreamReader(AbstractDataReader):
                 window=window.window_id,
                 records=len(window.records),
             )
+        for window in dropped:
+            # an incident, not a log line: the flight recorder captures
+            # a bundle on this event (docs/OBSERVABILITY.md)
+            events.emit(
+                events.STREAM_WINDOW_DROPPED,
+                window=window.window_id,
+                name=window.name,
+                records=len(window.records),
+            )
         return len(records)
 
-    def _seal_locked(self, chunk: List[dict]) -> StreamWindow:
+    def _seal_locked(self, chunk: List[dict],
+                     dropped_out: List[StreamWindow]) -> StreamWindow:
         window_id = self._next_window_id
         self._next_window_id += 1
         watermark = max(
@@ -218,23 +279,27 @@ class StreamReader(AbstractDataReader):
         if self._watermark_unix_s is None \
                 or watermark > self._watermark_unix_s:
             self._watermark_unix_s = watermark
+        # Windows seal in stream order at a fixed width, so window k
+        # always covers source records [k*W, (k+1)*W) — the invariant
+        # replay relies on.
         window = StreamWindow(
-            f"stream:w{window_id:06d}", window_id, chunk, watermark
+            f"stream:w{window_id:06d}", window_id, chunk, watermark,
+            start_index=window_id * self._window_records,
         )
         self._sealed[window.name] = window
         self._unclaimed.append(window)
         while len(self._sealed) > self._max_buffered:
-            name, dropped = self._sealed.popitem(last=False)
+            name, evicted = self._sealed.popitem(last=False)
             self._unclaimed = [
                 w for w in self._unclaimed if w.name != name
             ]
             self._dropped_total.inc()
+            dropped_out.append(evicted)
             logger.warning(
                 "stream window %s dropped (buffer cap %d; training is "
                 "%d windows behind)", name, self._max_buffered,
                 len(self._sealed),
             )
-            del dropped
         return window
 
     def take_new_windows(self) -> List[StreamWindow]:
@@ -249,6 +314,47 @@ class StreamReader(AbstractDataReader):
         """Free a fully-trained window's records."""
         with self._lock:
             return self._sealed.pop(name, None) is not None
+
+    def restore_window(
+        self,
+        name: str,
+        window_id: int,
+        start_index: int,
+        num_records: int,
+        watermark_unix_s: float,
+    ) -> bool:
+        """Re-buffer an un-acked window from the replayable source —
+        what a restarted master (or a drained buffer) calls instead of
+        forfeiting the window.  The regenerated records are
+        byte-identical to the originals because source content is a
+        pure function of (seed, index).  Returns False when the source
+        cannot replay (no `records` method).  The watermark never moves
+        backward: replays restore data, not time."""
+        source_records = getattr(self._source, "records", None)
+        if source_records is None:
+            return False
+        chunk = source_records(
+            int(start_index), int(num_records),
+            event_unix_s=float(watermark_unix_s),
+        )
+        if len(chunk) != int(num_records):
+            return False
+        window = StreamWindow(
+            name, int(window_id), chunk, float(watermark_unix_s),
+            start_index=int(start_index),
+        )
+        with self._lock:
+            if name in self._sealed:
+                return True
+            self._sealed[name] = window
+        self._replayed_total.inc()
+        events.emit(
+            events.STREAM_WINDOW_RESTORED,
+            window=int(window_id),
+            name=name,
+            records=int(num_records),
+        )
+        return True
 
     # ---- lag ------------------------------------------------------------
 
@@ -309,5 +415,6 @@ class StreamReader(AbstractDataReader):
             "polls": int(self._polls.value()),
             "poll_faults": int(self._poll_faults.value()),
             "dropped_windows": int(self._dropped_total.value()),
+            "replayed_windows": int(self._replayed_total.value()),
             "watermark_lag_s": round(self.lag_s(), 6),
         }

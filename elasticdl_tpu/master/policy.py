@@ -19,7 +19,10 @@ Per tick, in priority order, at most ONE action:
 2. **Scale up** by `scale_step` (whole groups when workers_per_group>1)
    when the task backlog per worker has exceeded `backlog_per_worker`
    for `backlog_ticks` consecutive ticks and the fleet is below
-   `max_workers`.
+   `max_workers` — or, on perpetual jobs wired with a `stream_lag_fn`,
+   when the stream watermark lag has exceeded `stream_lag_s` for
+   `stream_lag_ticks` consecutive ticks (reason `stream_lag`): the
+   trainer fleet is falling behind live ingest.
 3. **Scale down** (whole groups, straggler-preferring victims) when the
    fleet-wide `data_wait` phase share — the fraction of worker step time
    spent blocked on the input pipeline, computed as a windowed delta of
@@ -76,6 +79,13 @@ class PolicyConfig:
     data_wait_ticks: int = 3
     scale_step: int = 1              # workers per action (group-aligned)
     scale_hold_ticks: int = 2        # quiet ticks after any scale action
+    # Perpetual (streaming) jobs only: scale up when the stream watermark
+    # lag (now - oldest armed window's watermark, reported by
+    # `stream_lag_fn`) has exceeded `stream_lag_s` for `stream_lag_ticks`
+    # consecutive ticks — the trainers aren't keeping up with ingest.
+    # 0 disables the signal (batch jobs have no watermark).
+    stream_lag_s: float = 0.0
+    stream_lag_ticks: int = 3
 
     @classmethod
     def from_args(cls, args) -> "PolicyConfig":
@@ -99,6 +109,8 @@ class PolicyConfig:
             data_wait_ticks=getattr(args, "data_wait_ticks", 3),
             scale_step=getattr(args, "scale_step", 1),
             scale_hold_ticks=getattr(args, "scale_hold_ticks", 2),
+            stream_lag_s=getattr(args, "stream_lag_s", 0.0),
+            stream_lag_ticks=getattr(args, "stream_lag_ticks", 3),
         )
 
 
@@ -117,11 +129,15 @@ class PolicyEngine:
         config: PolicyConfig,
         telemetry_fn: Optional[Callable[[], dict]] = None,
         clock: Callable[[], float] = time.time,
+        stream_lag_fn: Optional[Callable[[], float]] = None,
     ):
         self._tm = task_manager
         self._pods = pod_manager
         self.config = config
         self._telemetry_fn = telemetry_fn or (lambda: {})
+        # Perpetual jobs: seconds of watermark lag behind the stream head
+        # (0.0 when idle / not streaming).  None disables the signal.
+        self._stream_lag_fn = stream_lag_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -130,6 +146,8 @@ class PolicyEngine:
         self._tick_count = 0
         self._backlog_streak = 0
         self._data_wait_streak = 0
+        self._stream_lag_streak = 0
+        self._last_stream_lag_s = 0.0
         self._hold_ticks = 0
         self._evictions_used = 0
         self._last_eviction_at: Optional[float] = None
@@ -172,6 +190,12 @@ class PolicyEngine:
             "master_policy_data_wait_ratio",
             lambda: self._last_data_wait_ratio,
             "fleet data_wait share of step time over the last tick window",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_policy_stream_lag_seconds",
+            lambda: self._last_stream_lag_s,
+            "stream watermark lag behind ingest at the last tick "
+            "(perpetual jobs; 0 when the signal is disabled)",
         )
 
     # ---- lifecycle -----------------------------------------------------
@@ -315,6 +339,22 @@ class PolicyEngine:
         else:
             self._data_wait_streak = 0
 
+        # Stream watermark lag (perpetual jobs): how far the oldest armed
+        # window's event time trails the ingest head.  Sustained lag means
+        # the trainer fleet is underprovisioned for the stream rate.
+        self._last_stream_lag_s = 0.0
+        if self._stream_lag_fn is not None and cfg.stream_lag_s > 0:
+            try:
+                self._last_stream_lag_s = max(
+                    0.0, float(self._stream_lag_fn())
+                )
+            except Exception:
+                logger.exception("stream lag probe failed")
+        if self._last_stream_lag_s > cfg.stream_lag_s:
+            self._stream_lag_streak += 1
+        else:
+            self._stream_lag_streak = 0
+
     def _aligned_step(self, room: int) -> int:
         """Per-tick step, aligned to whole groups and capped by room."""
         cfg = self.config
@@ -354,6 +394,28 @@ class PolicyEngine:
                     tick=self._tick_count, requested=step,
                     launched=launched,
                     backlog_per_worker=record["backlog_per_worker"],
+                )
+                return record
+
+        if self._stream_lag_streak >= cfg.stream_lag_ticks:
+            step = self._aligned_step(cfg.max_workers - len(alive))
+            if step > 0:
+                launched = self._pods.scale_up(step)
+                self._hold_ticks = cfg.scale_hold_ticks
+                self._backlog_streak = 0
+                self._data_wait_streak = 0
+                self._stream_lag_streak = 0
+                record = self._record(
+                    "scale_up", "stream_lag",
+                    stream_lag_s=round(self._last_stream_lag_s, 3),
+                    alive=len(alive), requested=step, launched=launched,
+                )
+                events.emit(
+                    events.POLICY_DECISION,
+                    action="scale_up", reason="stream_lag",
+                    tick=self._tick_count, requested=step,
+                    launched=launched,
+                    stream_lag_s=record["stream_lag_s"],
                 )
                 return record
 
@@ -413,6 +475,8 @@ class PolicyEngine:
                 "hold_ticks": self._hold_ticks,
                 "backlog_per_worker": round(self._last_backlog_ratio, 3),
                 "data_wait_ratio": round(self._last_data_wait_ratio, 3),
+                "stream_lag_s": round(self._last_stream_lag_s, 3),
+                "stream_lag_streak": self._stream_lag_streak,
                 "decisions": list(self.decisions),
                 "interval_s": self.config.interval_s,
             }

@@ -174,6 +174,7 @@ class TaskManager:
         straggler_min_tasks: int = 3,
         clock: Callable[[], float] = time.time,
         perpetual: bool = False,
+        metrics_registry: Optional[metrics_lib.MetricsRegistry] = None,
     ):
         self._lock = threading.Lock()
         # Injectable clock: every lease/duration/dwell timestamp reads it,
@@ -217,7 +218,12 @@ class TaskManager:
         # RPC loop and burn its whole transient budget in seconds
         # (ADVICE r2) — another worker gets the window to serve it.
         self._transient_hold: Dict[int, float] = {}
-        self.counters = TaskCounters()
+        # `metrics_registry` lets a RESTARTED master adopt its
+        # predecessor's registry: counter families are get-or-create
+        # (values persist) and gauge_fn re-registration rebinds to the
+        # new instance, so /metrics and the SLO history see one
+        # continuous job, not a reset.
+        self.counters = TaskCounters(metrics_registry)
         self.counters.registry.gauge_fn(
             "master_tasks_todo_count",
             lambda: float(len(self._todo)),
@@ -261,6 +267,17 @@ class TaskManager:
         self._last_window_id = -1
         self._last_window_name = ""
         self._armed_watermark_unix_s: Optional[float] = None
+        # Window ledger (perpetual + journaled): window_id -> armed-window
+        # state — name, absolute stream start index, record count, task
+        # size, watermark, the set of DONE task-start offsets (the
+        # completion bitmap), and the released ack.  Journaled on every
+        # mutation so a restarted master re-arms exactly the unfinished
+        # windows: no window trained twice, none silently lost.
+        self._window_ledger: Dict[int, dict] = {}
+        self._window_by_name: Dict[str, int] = {}
+        # window ids below this floor are released-and-pruned; arming one
+        # again is a no-op (exactly-once across restarts)
+        self._armed_floor = 0
         if self._perpetual:
             self._windows_armed_counter = self.counters.registry.counter(
                 "master_stream_windows_armed_total",
@@ -273,6 +290,19 @@ class TaskManager:
             self._rearm_faults_counter = self.counters.registry.counter(
                 "master_stream_rearm_faults_total",
                 "window re-arms skipped by an injected task.rearm fault",
+            )
+            self._windows_released_counter = self.counters.registry.counter(
+                "master_stream_windows_released_total",
+                "armed windows fully trained and acked in the ledger",
+            )
+            self._windows_lost_counter = self.counters.registry.counter(
+                "master_stream_windows_lost_total",
+                "armed windows forfeited unreplayable — must stay 0",
+            )
+            self._duplicate_reports_counter = self.counters.registry.counter(
+                "master_stream_duplicate_reports_total",
+                "task reports for window offsets the ledger already "
+                "recorded done",
             )
             self.counters.registry.gauge_fn(
                 "master_stream_watermark_lag_seconds",
@@ -386,6 +416,21 @@ class TaskManager:
             # when their rounds re-run after a restart
             "records_done": self._training_records_done,
         }
+        if self._perpetual:
+            state["windows"] = [
+                [
+                    wid, e["name"], e["start"], e["records"],
+                    e["per_task"], e["watermark"],
+                    sorted(e["done"]), bool(e["released"]),
+                ]
+                for wid, e in sorted(self._window_ledger.items())
+            ]
+            state["armed_floor"] = self._armed_floor
+            state["windows_armed"] = self._armed_windows
+            state["tasks_armed"] = self._armed_tasks
+            state["last_window_id"] = self._last_window_id
+            state["last_window_name"] = self._last_window_name
+            state["armed_watermark"] = self._armed_watermark_unix_s
         tmp = self._persist_path + ".tmp"
         try:
             os.makedirs(
@@ -422,6 +467,27 @@ class TaskManager:
                 (int(e[0]), int(e[1]))
                 for e in state.get("epoch_history", [])
             ]
+            windows = [
+                {
+                    "window_id": int(e[0]),
+                    "name": str(e[1]),
+                    "start": int(e[2]),
+                    "records": int(e[3]),
+                    "per_task": int(e[4]),
+                    "watermark": float(e[5]),
+                    "done": {int(d) for d in e[6]},
+                    "released": bool(e[7]),
+                }
+                for e in state.get("windows", [])
+            ]
+            perpetual_saved = {
+                "armed_floor": int(state.get("armed_floor", 0)),
+                "windows_armed": int(state.get("windows_armed", 0)),
+                "tasks_armed": int(state.get("tasks_armed", 0)),
+                "last_window_id": int(state.get("last_window_id", -1)),
+                "last_window_name": str(state.get("last_window_name", "")),
+                "armed_watermark": state.get("armed_watermark"),
+            }
         except (
             OSError, ValueError, TypeError, IndexError, KeyError,
             AttributeError,
@@ -429,6 +495,11 @@ class TaskManager:
             logger.warning(
                 "task-state restore failed (%s); starting the epoch fresh",
                 exc,
+            )
+            return
+        if self._perpetual:
+            self._restore_perpetual_locked(
+                windows, perpetual_saved, saved_records
             )
             return
         if not self._training_shards:
@@ -504,6 +575,53 @@ class TaskManager:
         )
         self._persist_locked()
 
+    def _restore_perpetual_locked(
+        self, windows: List[dict], saved: dict, saved_records: int
+    ) -> None:
+        """Rebuild the window ledger from the journal and re-arm exactly
+        the unfinished work: for every unreleased window, TRAINING tasks
+        are re-created for the offsets its completion bitmap does NOT
+        cover.  Released windows stay released (never re-trained); done
+        offsets stay done (never re-queued) — the exactly-once guarantee
+        across master restarts."""
+        self._armed_floor = saved["armed_floor"]
+        self._armed_windows = saved["windows_armed"]
+        self._armed_tasks = saved["tasks_armed"]
+        self._last_window_id = saved["last_window_id"]
+        self._last_window_name = saved["last_window_name"]
+        if saved["armed_watermark"] is not None:
+            self._armed_watermark_unix_s = float(saved["armed_watermark"])
+        self._training_records_done = max(0, saved_records)
+        self.counters.records_done = self._training_records_done
+        rearmed_windows = rearmed_tasks = 0
+        for entry in windows:
+            wid = entry.pop("window_id")
+            self._window_ledger[wid] = entry
+            self._window_by_name[entry["name"]] = wid
+            if entry["released"]:
+                continue
+            rearmed = 0
+            for lo in range(0, entry["records"], entry["per_task"]):
+                if lo in entry["done"]:
+                    continue
+                shard = pb.Shard(
+                    name=entry["name"], start=lo,
+                    end=min(lo + entry["per_task"], entry["records"]),
+                )
+                self._todo.append(self._new_task(shard, pb.TRAINING))
+                rearmed += 1
+            if rearmed:
+                rearmed_windows += 1
+                rearmed_tasks += rearmed
+        self._prune_released_locked()
+        logger.info(
+            "Restored window ledger: %d windows journaled, %d unfinished "
+            "re-armed (%d tasks), armed_floor=%d",
+            len(windows), rearmed_windows, rearmed_tasks,
+            self._armed_floor,
+        )
+        self._persist_locked()
+
     # ---- perpetual (online) mode ---------------------------------------
 
     def arm_window(
@@ -513,12 +631,17 @@ class TaskManager:
         records_per_task: int,
         watermark_unix_s: Optional[float] = None,
         window_id: Optional[int] = None,
+        start_index: int = 0,
     ) -> Optional[int]:
         """Turn one sealed stream window into TRAINING tasks (perpetual
-        mode's replacement for epoch refills).  Returns the number of
-        tasks armed, or None when an injected `task.rearm` fault skipped
-        the re-arm ATOMICALLY (no tasks enqueued; the caller keeps the
-        window pending and re-offers it — docs/ROBUSTNESS.md)."""
+        mode's replacement for epoch refills) and open its ledger entry.
+        Returns the number of tasks armed, or None when an injected
+        `task.rearm` fault skipped the re-arm ATOMICALLY (no tasks
+        enqueued; the caller keeps the window pending and re-offers it —
+        docs/ROBUSTNESS.md).  Arming is idempotent per window id: a
+        window the ledger already tracks (or already released) returns 0
+        instead of double-arming — what makes re-offers after a master
+        restart safe."""
         if not self._perpetual:
             raise RuntimeError(
                 "arm_window requires TaskManager(perpetual=True)"
@@ -534,6 +657,11 @@ class TaskManager:
             return None
         per_task = max(1, int(records_per_task))
         with self._lock:
+            if window_id is not None and (
+                int(window_id) < self._armed_floor
+                or int(window_id) in self._window_ledger
+            ):
+                return 0
             n = 0
             for lo in range(0, int(num_records), per_task):
                 shard = pb.Shard(
@@ -547,10 +675,21 @@ class TaskManager:
             self._last_window_name = window_name
             if window_id is not None:
                 self._last_window_id = int(window_id)
+                self._window_ledger[int(window_id)] = {
+                    "name": window_name,
+                    "start": int(start_index),
+                    "records": int(num_records),
+                    "per_task": per_task,
+                    "watermark": float(watermark_unix_s or 0.0),
+                    "done": set(),
+                    "released": False,
+                }
+                self._window_by_name[window_name] = int(window_id)
             if watermark_unix_s is not None:
                 self._armed_watermark_unix_s = float(watermark_unix_s)
             # a re-arm revives a queue that momentarily drained
             self._finished = False
+            self._persist_locked()
         self._windows_armed_counter.inc()
         self._tasks_rearmed_counter.inc(n)
         events.emit(
@@ -560,6 +699,85 @@ class TaskManager:
             tasks=n,
         )
         return n
+
+    def _prune_released_locked(self) -> None:
+        """Drop the contiguous released prefix of the ledger, moving the
+        armed floor past it — bounds the journal while keeping every
+        pruned id refused by `arm_window` (released stays released)."""
+        while self._window_ledger:
+            wid = min(self._window_ledger)
+            if not self._window_ledger[wid]["released"]:
+                break
+            entry = self._window_ledger.pop(wid)
+            self._window_by_name.pop(entry["name"], None)
+            self._armed_floor = max(self._armed_floor, wid + 1)
+
+    def release_window(self, window_id: int) -> bool:
+        """Ack one fully-trained window in the ledger.  Returns True
+        when this call performed the release — the acknowledgment
+        GL-LEDGER requires call sites to consume.  The window's
+        journaled per-shard completions are pruned with it, so the
+        perpetual journal stays bounded by the open-window set."""
+        window_id = int(window_id)
+        with self._lock:
+            entry = self._window_ledger.get(window_id)
+            if entry is None or entry["released"]:
+                return False
+            entry["released"] = True
+            name = entry["name"]
+            for key in [
+                k for k in self._done_training_shards if k[0] == name
+            ]:
+                del self._done_training_shards[key]
+            self._prune_released_locked()
+            self._persist_locked()
+        self._windows_released_counter.inc()
+        events.emit(events.STREAM_WINDOW_RELEASED, window=window_id)
+        return True
+
+    def forfeit_window(self, window_id: int) -> bool:
+        """Last-resort give-up on a window that can neither train nor
+        replay.  Counted as LOST (`master_stream_windows_lost_total` —
+        the series the acceptance gate pins to 0); the ledger entry
+        closes so the queue is not wedged forever."""
+        window_id = int(window_id)
+        with self._lock:
+            entry = self._window_ledger.get(window_id)
+            if entry is None or entry["released"]:
+                return False
+            entry["released"] = True
+            name = entry["name"]
+            self._todo = deque(
+                t for t in self._todo if t.shard.name != name
+            )
+            for key in [
+                k for k in self._done_training_shards if k[0] == name
+            ]:
+                del self._done_training_shards[key]
+            self._prune_released_locked()
+            self._persist_locked()
+        self._windows_lost_counter.inc()
+        logger.error("stream window %d forfeited (unreplayable)", window_id)
+        return True
+
+    def open_windows(self) -> List[dict]:
+        """Unreleased ledger entries (ascending window id) — what a
+        restarted pipeline uses to rebuild its per-window bookkeeping
+        and re-buffer replayed records."""
+        with self._lock:
+            return [
+                {
+                    "window_id": wid,
+                    "name": e["name"],
+                    "start": e["start"],
+                    "records": e["records"],
+                    "per_task": e["per_task"],
+                    "watermark": e["watermark"],
+                    "done": sorted(e["done"]),
+                }
+                for wid, e in sorted(self._window_ledger.items())
+                if not e["released"]
+            ]
 
     def _armed_watermark_lag(self) -> float:
         watermark = self._armed_watermark_unix_s
@@ -580,6 +798,17 @@ class TaskManager:
                 "tasks_rearmed": self._armed_tasks,
                 "rearm_faults": int(self._rearm_faults_counter.value()),
                 "watermark_lag_s": round(self._armed_watermark_lag(), 6),
+                "windows_released": int(
+                    self._windows_released_counter.value()
+                ),
+                "windows_lost": int(self._windows_lost_counter.value()),
+                "duplicate_reports": int(
+                    self._duplicate_reports_counter.value()
+                ),
+                "open_windows": sum(
+                    1 for e in self._window_ledger.values()
+                    if not e["released"]
+                ),
             }
 
     @property
@@ -697,6 +926,17 @@ class TaskManager:
                     self._done_training_shards[
                         tuple(self._shard_key(task.shard))
                     ] = model_version
+                    # window ledger: mark this task's offset done in its
+                    # window's completion bitmap; a re-report of a done
+                    # offset (at-least-once redelivery) is counted, not
+                    # double-recorded
+                    wid = self._window_by_name.get(task.shard.name)
+                    if wid is not None:
+                        entry_w = self._window_ledger[wid]
+                        if task.shard.start in entry_w["done"]:
+                            self._duplicate_reports_counter.inc()
+                        else:
+                            entry_w["done"].add(int(task.shard.start))
                     self._persist_locked()
             elif transient and (
                 self._transient_count.get(task_id, 0)

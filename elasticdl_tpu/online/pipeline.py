@@ -14,12 +14,25 @@ them into one measured loop (docs/ONLINE.md):
         -> FreshnessTracker + MetricHistory + SloEvaluator
            (staleness_p99 measures REAL stream-to-serve lag)
 
+Elasticity (this PR's tentpole): training fans out over `workers`
+LOGICAL trainer workers — distinct lease identities against the task
+manager and distinct shard owners in a `ShardedTieredStore` (per-row
+CTR statistics sharded `row % num_shards`).  `kill_worker` requeues a
+dead trainer's leases and hands its shard slices to the survivors
+(`store.shard_handoff` fault-covered); `restart_master` rebuilds the
+perpetual queue from the window-ledger journal so every unfinished
+window re-arms exactly its undone shards — no window trains twice, none
+is silently lost.  With `max_workers > workers` a `PolicyEngine`
+scales the trainer pool mid-stream on watermark lag and armed-window
+backlog.
+
 Every time-reading collaborator shares ONE injectable clock, and every
-decision maker (task manager, fleet manager, SLO evaluator, fault
-registry) is already deterministic under a fake clock — so the chaos
-variant of `bench.py --online` replays byte-identically across
-same-seed runs while a stream stall, a replica kill, and a reload fault
-land mid-loop.
+decision maker (task manager, fleet manager, SLO evaluator, policy
+engine, shard map, fault registry) is already deterministic under a
+fake clock — so the chaos variant of `bench.py --online` replays
+byte-identically across same-seed runs while a stream stall, a trainer
+kill, a master restart, a shard-handoff fault, and a reload fault land
+mid-loop.
 
 Single-process by design: the serving replicas are in-process servicers
 behind killable clients (the bench_serving_fleet harness shape,
@@ -30,9 +43,11 @@ task manager already speak the worker lease protocol.
 
 from __future__ import annotations
 
+import math
+import os
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 
@@ -48,12 +63,15 @@ from elasticdl_tpu.data.reader.stream_reader import (
     StreamReader,
 )
 from elasticdl_tpu.master.freshness import FreshnessTracker
+from elasticdl_tpu.master.policy import PolicyConfig, PolicyEngine
 from elasticdl_tpu.master.serving_fleet import (
     ServingFleetConfig,
     ServingFleetManager,
 )
 from elasticdl_tpu.master.task_manager import TaskManager
 from elasticdl_tpu.proto.service import FleetRouter, InProcessServingClient
+from elasticdl_tpu.store import checkpoint as store_checkpoint
+from elasticdl_tpu.store.sharding import ShardedTieredStore
 
 logger = get_logger(__name__)
 
@@ -75,6 +93,13 @@ class OnlineConfig:
     step_skew_slo: int = 16
     source_users: int = 512
     source_items: int = 128
+    # ---- elastic training pool + sharded store ----
+    workers: int = 1                 # logical trainer workers
+    num_shards: int = 4              # store row-space shards (row % N)
+    store_cache_rows: int = 512      # total hot-row capacity, all shards
+    max_workers: int = 0             # > workers enables the PolicyEngine
+    stream_lag_s: float = 60.0       # scale-up threshold (watermark lag)
+    stream_lag_ticks: int = 2
 
 
 class _KillableClient:
@@ -94,6 +119,91 @@ class _KillableClient:
         if self.killed:
             raise ConnectionError("replica killed")
         return self._inner.health(request, timeout=timeout)
+
+
+class _TrainerPool:
+    """PodManager-shaped adapter over the pipeline's LOGICAL trainer
+    workers — distinct lease identities + shard owners, not processes.
+    Implements exactly the surface the PolicyEngine drives
+    (alive_workers / evict_worker / scale_up / scale_down), so the
+    master's one policy loop actuates the perpetual trainer fleet the
+    same way it actuates batch pods."""
+
+    def __init__(self, pipeline: "OnlinePipeline", worker_ids):
+        self._pipeline = pipeline
+        self._alive: List[int] = sorted(int(w) for w in worker_ids)
+        self._next_id = (max(self._alive) + 1) if self._alive else 0
+
+    def alive_workers(self) -> List[int]:
+        return list(self._alive)
+
+    def drop_worker(self, worker_id: int) -> bool:
+        """Remove WITHOUT replacement (the chaos kill path); shard
+        evacuation and lease recovery are the pipeline's job."""
+        if worker_id not in self._alive or len(self._alive) <= 1:
+            return False
+        self._alive.remove(worker_id)
+        return True
+
+    def evict_worker(self, worker_id: int) -> bool:
+        """Evict + relaunch on a fresh id (the group-restart shape the
+        real PodManager has): the victim's shards hand off to the
+        survivors, then the replacement joins and takes a fair share
+        back — both sides of the handoff protocol in one action."""
+        if worker_id not in self._alive or len(self._alive) <= 1:
+            return False
+        self._alive.remove(worker_id)
+        self._pipeline._retire_worker(worker_id)
+        new_id = self._next_id
+        self._next_id += 1
+        self._alive.append(new_id)
+        self._alive.sort()
+        self._pipeline._admit_worker(new_id)
+        return True
+
+    def scale_up(self, n: int) -> int:
+        launched = 0
+        for _ in range(max(0, int(n))):
+            new_id = self._next_id
+            self._next_id += 1
+            self._alive.append(new_id)
+            self._pipeline._admit_worker(new_id)
+            launched += 1
+        self._alive.sort()
+        return launched
+
+    def scale_down(self, n: int, prefer=()) -> List[int]:
+        victims: List[int] = []
+        preferred = [w for w in prefer if w in self._alive]
+        rest = [
+            w for w in sorted(self._alive, reverse=True)
+            if w not in preferred
+        ]
+        for w in preferred + rest:
+            if len(victims) >= int(n):
+                break
+            if len(self._alive) - len(victims) <= 1:
+                break
+            victims.append(w)
+        for w in victims:
+            self._alive.remove(w)
+            self._pipeline._retire_worker(w)
+        return victims
+
+
+class _TaskManagerProxy:
+    """The PolicyEngine holds its task manager by reference, but the
+    pipeline REPLACES the task manager on a master restart.  This thin
+    forwarder keeps the engine pointed at whichever instance is live."""
+
+    def __init__(self, pipeline: "OnlinePipeline"):
+        self._pipeline = pipeline
+
+    def snapshot(self) -> dict:
+        return self._pipeline.task_manager.snapshot()
+
+    def straggler_snapshot(self) -> dict:
+        return self._pipeline.task_manager.straggler_snapshot()
 
 
 class OnlinePipeline:
@@ -133,9 +243,52 @@ class OnlinePipeline:
         )
         self._pending_windows = []          # sealed, not yet armed
         self._window_tasks_left = {}        # window name -> tasks open
+        self._window_ids = {}               # window name -> window id
 
-        # ---- perpetual task queue ---------------------------------------
-        self.task_manager = TaskManager(perpetual=True, clock=clock)
+        # ---- perpetual task queue (journaled window ledger) -------------
+        # The journal is what makes `restart_master` exactly-once: the
+        # replacement re-arms unfinished windows' UNDONE shards only.
+        self._checkpoint_dir = checkpoint_dir
+        self._journal_path = os.path.join(
+            checkpoint_dir, "window_ledger.json"
+        )
+        self.task_manager = TaskManager(
+            perpetual=True, clock=clock, persist_path=self._journal_path,
+        )
+        self.master_restarts = 0
+
+        # ---- sharded tiered store (per-row CTR statistics) --------------
+        # Row space = user rows then item rows (HostTier field-disjoint
+        # assignment over fields {0: user, 1: item}); the "ctr" plane
+        # accumulates [impressions, clicks] per row.  Host tier is
+        # master-resident, so a trainer death loses only cache residency
+        # — the handoff protocol's whole point.
+        self.store = ShardedTieredStore(
+            planes={"ctr": 2},
+            num_fields=2,
+            cache_rows=cfg.store_cache_rows,
+            num_shards=cfg.num_shards,
+            workers=range(max(1, cfg.workers)),
+        )
+        self._sidecar_steps: List[int] = []
+
+        # ---- elastic trainer pool + policy engine -----------------------
+        self.pool = _TrainerPool(self, range(max(1, cfg.workers)))
+        self._rr = 0                        # round-robin lease cursor
+        self.policy: Optional[PolicyEngine] = None
+        if cfg.max_workers > cfg.workers:
+            self.policy = PolicyEngine(
+                _TaskManagerProxy(self),
+                self.pool,
+                PolicyConfig(
+                    min_workers=1,
+                    max_workers=cfg.max_workers,
+                    stream_lag_s=cfg.stream_lag_s,
+                    stream_lag_ticks=cfg.stream_lag_ticks,
+                ),
+                clock=clock,
+                stream_lag_fn=self._stream_lag,
+            )
 
         # ---- trainer -----------------------------------------------------
         self.trainer = Trainer(spec.model, spec.optimizer, spec.loss)
@@ -234,6 +387,7 @@ class OnlinePipeline:
                 self.fleet_manager.metrics_registry,
                 self.reader.metrics_registry,
                 self.task_manager.counters.registry,
+                self.store.registry,
             ],
             clock=clock,
         )
@@ -245,12 +399,21 @@ class OnlinePipeline:
 
     # ---- one loop iteration ---------------------------------------------
 
-    def tick(self) -> dict:
-        """Poll -> arm -> train -> checkpoint -> serve.  Returns a small
-        progress dict for the caller's loop telemetry."""
+    def tick(self, max_train_tasks: Optional[int] = None) -> dict:
+        """Poll -> arm -> policy -> train -> checkpoint -> serve.
+        Returns a small progress dict for the caller's loop telemetry.
+        The policy tick runs BETWEEN arming and draining so its signals
+        (armed-window backlog, watermark lag) see the queue at its
+        fullest — the moment a scaling decision is actionable.
+        `max_train_tasks` caps this tick's training (a slow trainer
+        fleet in miniature): leftover tasks stay queued, which is what
+        lets chaos land a master restart while windows are mid-flight
+        and lets backlog build for the policy signals."""
         polled = self.reader.poll()
         self._arm_pending()
-        trained = self._drain_tasks()
+        if self.policy is not None:
+            self.policy.tick()
+        trained = self._drain_tasks(max_train_tasks)
         saved = self._maybe_checkpoint()
         self.fleet_manager.tick()
         self.history.tick()
@@ -274,43 +437,109 @@ class OnlinePipeline:
                 self.config.records_per_task,
                 watermark_unix_s=window.watermark_unix_s,
                 window_id=window.window_id,
+                start_index=window.start_index,
             )
             if n is None:
                 # injected task.rearm fault: the window stays pending and
                 # is re-offered next tick (docs/ROBUSTNESS.md)
                 still_pending.append(window)
-            else:
+            elif n > 0:
                 self._window_tasks_left[window.name] = n
+                self._window_ids[window.name] = window.window_id
+            # n == 0: the ledger already tracks (or released) this id —
+            # a re-offer after a master restart; bookkeeping was rebuilt
+            # from open_windows(), nothing to add.
         self._pending_windows = still_pending
 
-    def _drain_tasks(self) -> int:
+    def _lease_next(self):
+        """Round-robin one lease attempt over the alive trainer pool.
+        Returns (worker_id, task) or (None, None) when the queue is
+        drained for this tick."""
+        alive = self.pool.alive_workers()
+        for _ in range(len(alive)):
+            wid = alive[self._rr % len(alive)]
+            self._rr += 1
+            task = self.task_manager.get(wid)
+            if task is not None:
+                return wid, task
+        return None, None
+
+    def _drain_tasks(self, budget: Optional[int] = None) -> int:
         trained = 0
-        while True:
-            task = self.task_manager.get(0)
+        while budget is None or trained < budget:
+            wid, task = self._lease_next()
             if task is None:
                 return trained
             name = task.shard.name
             try:
                 records = list(self.reader.read_records(task))
             except LookupError:
-                # The window was dropped past the buffer cap: its data is
-                # gone for good, so retire the task (success, 0 records)
-                # rather than retry-looping on an unservable shard.
-                self.task_manager.report(task.task_id, True, worker_id=0)
-                self._window_done(name)
-                continue
+                # Not buffered — replay it from the deterministic source
+                # (the journal knows the window's stream offsets) instead
+                # of dropping the task blind.
+                if self._restore_window(name):
+                    records = list(self.reader.read_records(task))
+                else:
+                    self._forfeit(wid, task)
+                    continue
             batch = self.spec.feed(records, self.reader.metadata)
             self.state, loss = self.trainer.train_on_batch(
                 self.state, batch
             )
+            self._fold_store_stats(records)
             self._last_loss = float(loss)
             self._examples_trained += len(records)
             trained += 1
             self.task_manager.report(
-                task.task_id, True, worker_id=0, records=len(records),
+                task.task_id, True, worker_id=wid, records=len(records),
                 model_version=int(self.state.step),
             )
             self._window_done(name)
+        return trained
+
+    def _fold_store_stats(self, records) -> None:
+        """Per trained task: admit the batch's (user, item) rows through
+        the sharded cache plan, then fold [impressions, clicks] into the
+        host "ctr" plane — the live state a shard handoff must not lose
+        (the chaos test pins its byte stability)."""
+        if not records:
+            return
+        sparse = np.array(
+            [[r["user"], r["item"]] for r in records], np.int64
+        )
+        plan = self.store.prepare(sparse)
+        clicked = np.array([r["clicked"] for r in records], np.float32)
+        # rows flatten row-major (user, item per record): each record's
+        # click applies to both of its rows
+        self.store.fold_stats(
+            plan.rows, np.repeat(clicked, plan.rows.shape[1])
+        )
+
+    def _restore_window(self, name: str) -> bool:
+        """Re-buffer an un-acked window's records from the source (exact
+        replay: the stream is a pure function of (seed, index))."""
+        for entry in self.task_manager.open_windows():
+            if entry["name"] == name:
+                return self.reader.restore_window(
+                    name, entry["window_id"], entry["start"],
+                    entry["records"], entry["watermark"],
+                )
+        return False
+
+    def _forfeit(self, wid: int, task) -> None:
+        """Last resort for a window that can neither train nor replay
+        (non-replayable source): retire the task and close the ledger
+        entry as LOST so the queue is not wedged forever."""
+        name = task.shard.name
+        self.task_manager.report(task.task_id, True, worker_id=wid)
+        window_id = self._window_ids.pop(name, None)
+        if window_id is not None:
+            self.task_manager.forfeit_window(window_id)
+        self._window_tasks_left.pop(name, None)
+        released = self.reader.release_window(name)
+        logger.error(
+            "window %s forfeited (buffer=%s)", name, released,
+        )
 
     def _window_done(self, name: str) -> None:
         left = self._window_tasks_left.get(name)
@@ -321,7 +550,22 @@ class OnlinePipeline:
             self._window_tasks_left[name] = left
             return
         del self._window_tasks_left[name]
-        self.reader.release_window(name)
+        # BOTH acknowledgments are consumed (GL-LEDGER): the ledger's
+        # release journals the window as done, the reader's frees the
+        # buffered records.
+        window_id = self._window_ids.pop(name, None)
+        acked = (
+            self.task_manager.release_window(window_id)
+            if window_id is not None else False
+        )
+        released = self.reader.release_window(name)
+        if window_id is not None and not acked:
+            logger.warning(
+                "window %s (%s) release not acked by the ledger",
+                name, window_id,
+            )
+        if not released:
+            logger.warning("window %s was not buffered at release", name)
         self._windows_trained += 1
         self._windows_since_save += 1
 
@@ -333,7 +577,121 @@ class OnlinePipeline:
             return False   # injected checkpoint.write fault: next cadence
         self.saver.wait_until_finished()
         self._latest_saved = int(self.state.step)
+        # Sharded-store sidecar rides the same cadence: it is the state
+        # `rebuild_shard` recovers a handed-off shard's host rows from.
+        store_checkpoint.save_sharded_sidecar(
+            self._checkpoint_dir, self._latest_saved, self.store
+        )
+        self._sidecar_steps.append(self._latest_saved)
+        if len(self._sidecar_steps) > self.config.keep_max:
+            self._sidecar_steps = self._sidecar_steps[
+                -self.config.keep_max:
+            ]
+            store_checkpoint.prune_sidecars(
+                self._checkpoint_dir, self._sidecar_steps
+            )
         return True
+
+    # ---- elasticity: trainer pool, shard handoff, master restart --------
+
+    def _load_sharded_sidecar(self):
+        """Latest sharded sidecar, or None before the first save."""
+        for step in reversed(self._sidecar_steps):
+            if store_checkpoint.has_sharded_sidecar(
+                    self._checkpoint_dir, step):
+                return store_checkpoint.load_sharded_sidecar(
+                    self._checkpoint_dir, step
+                )
+        return None
+
+    def _retire_worker(self, worker_id: int) -> None:
+        """Pool callback (evict / scale_down): requeue the worker's
+        leases, evacuate its shard slices."""
+        recovered = self.task_manager.recover_tasks(worker_id)
+        moves = self.store.handoff(
+            dead_worker=worker_id, sidecar=self._load_sharded_sidecar()
+        )
+        logger.info(
+            "trainer %d retired: %d tasks recovered, %d shards moved",
+            worker_id, recovered, len(moves),
+        )
+
+    def _admit_worker(self, worker_id: int) -> None:
+        """Pool callback (evict relaunch / scale_up): rebalance shards
+        toward the joiner."""
+        moves = self.store.join(worker_id)
+        logger.info(
+            "trainer %d admitted: %d shards moved", worker_id, len(moves)
+        )
+
+    def _stream_lag(self) -> float:
+        online = self.task_manager.online_snapshot() or {}
+        return float(online.get("watermark_lag_s", 0.0))
+
+    def kill_worker(self, worker_id: int) -> dict:
+        """Chaos helper: a trainer dies mid-run.  Its leases requeue
+        (lease recovery), its shard slices hand off to the survivors
+        (`store.shard_handoff` fault-covered), and the pool shrinks —
+        subsequent ticks drain with the survivors."""
+        if not self.pool.drop_worker(worker_id):
+            raise ValueError(
+                f"cannot kill trainer {worker_id}: not alive, or last one"
+            )
+        recovered = self.task_manager.recover_tasks(worker_id)
+        moves = self.store.handoff(
+            dead_worker=worker_id, sidecar=self._load_sharded_sidecar()
+        )
+        logger.info(
+            "trainer %d killed: %d tasks recovered, %d shards handed off",
+            worker_id, recovered, len(moves),
+        )
+        return {"recovered_tasks": recovered, "handoffs": len(moves)}
+
+    def restart_master(self) -> dict:
+        """Chaos helper: the master's brain dies and a replacement
+        rebuilds the perpetual queue from the window-ledger journal.
+        Unfinished windows re-arm exactly their UNDONE shards (completed
+        shards never retrain); nothing is lost because un-acked windows
+        replay from the deterministic source on demand.  The replacement
+        adopts the predecessor's metrics registry, so the released/lost
+        counters read as one continuous job."""
+        self.task_manager = TaskManager(
+            perpetual=True, clock=self._clock,
+            persist_path=self._journal_path,
+            metrics_registry=self.task_manager.counters.registry,
+        )
+        self.master_restarts += 1
+        # Per-window bookkeeping is in-memory master state: rebuild it
+        # from the restored ledger.  A window whose every shard was done
+        # but whose release was lost with the old master releases now.
+        self._window_tasks_left = {}
+        self._window_ids = {}
+        restored = self.task_manager.open_windows()
+        for entry in restored:
+            total = math.ceil(entry["records"] / entry["per_task"])
+            left = total - len(entry["done"])
+            self._window_ids[entry["name"]] = entry["window_id"]
+            if left > 0:
+                self._window_tasks_left[entry["name"]] = left
+            else:
+                acked = self.task_manager.release_window(
+                    entry["window_id"]
+                )
+                released = self.reader.release_window(entry["name"])
+                self._window_ids.pop(entry["name"], None)
+                logger.info(
+                    "window %s completed under the old master; released "
+                    "on restore (ledger=%s buffer=%s)",
+                    entry["name"], acked, released,
+                )
+        logger.info(
+            "master restarted (#%d): %d open windows restored",
+            self.master_restarts, len(restored),
+        )
+        return {
+            "windows_restored": len(restored),
+            "tasks_rearmed": sum(self._window_tasks_left.values()),
+        }
 
     # ---- serve side -------------------------------------------------------
 
@@ -363,6 +721,11 @@ class OnlinePipeline:
             for rep in fleet.get("replicas", {}).values()
         ]
         online["last_reload_step"] = max(steps) if steps else 0
+        store_stats = self.store.stats()
+        online["handoffs"] = store_stats["handoffs"]
+        online["pending_handoffs"] = store_stats["pending_handoffs"]
+        online["alive_trainers"] = len(self.pool.alive_workers())
+        online["master_restarts"] = self.master_restarts
         return online
 
     def snapshot(self) -> dict:
@@ -381,6 +744,14 @@ class OnlinePipeline:
             "serving_fleet": self.fleet_manager.snapshot(),
             "freshness": self.freshness.snapshot(),
             "slo": slo,
+            "store": self.store.stats(),
+            "trainers": {
+                "alive": self.pool.alive_workers(),
+                "master_restarts": self.master_restarts,
+            },
+            "policy": (
+                self.policy.snapshot() if self.policy is not None else None
+            ),
             "windows_trained": self._windows_trained,
             "examples_trained": self._examples_trained,
             "model_step": int(self.state.step),

@@ -163,6 +163,29 @@ class HotRowCache:
             misses=misses,
         )
 
+    # ---- invalidation (shard handoff) ----------------------------------
+
+    def reset(self) -> None:
+        """Drop all residency and scores — a handed-off shard's
+        successor starts cold and lets admission traffic rebuild."""
+        self._slot_of.clear()
+        self.row_of.fill(-1)
+        self._score.fill(0.0)
+
+    def invalidate_rows(self, rows: np.ndarray) -> int:
+        """Evict specific store rows from the bookkeeping (no device
+        traffic — pair with store.device.zero_cache_slots when the
+        slots' on-device values must also be cleared).  Returns the
+        number of rows that were resident."""
+        n = 0
+        for row in np.asarray(rows, np.int64).reshape(-1):
+            slot = self._slot_of.pop(int(row), None)
+            if slot is not None:
+                self.row_of[slot] = -1
+                self._score[slot] = 0.0
+                n += 1
+        return n
+
     # ---- serialization -------------------------------------------------
 
     def state_arrays(self):

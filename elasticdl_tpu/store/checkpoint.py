@@ -160,17 +160,119 @@ def load_sidecar(checkpoint_dir: str, step: int) -> TieredSidecar:
     return TieredSidecar(meta, host_state, row_of, score, cache_values)
 
 
+SHARDED_ROOT = ".sharded"
+
+
+def sharded_sidecar_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(checkpoint_dir), SHARDED_ROOT, str(int(step))
+    )
+
+
+def save_sharded_sidecar(checkpoint_dir: str, step: int, store) -> str:
+    """Sidecar for a `ShardedTieredStore`: the shared host tier, every
+    shard's cache residency slice, and the shard->worker map.  Same
+    torn-write discipline as `save_sidecar` (meta.json lands last)."""
+    d = sharded_sidecar_dir(checkpoint_dir, step)
+    os.makedirs(d, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for key, value in store.host.state_dict().items():
+        arrays[f"host__{key}"] = value
+    for key, value in store.cache_state().items():
+        arrays[f"cache__{key}"] = value
+
+    npz_path = os.path.join(d, "store.npz")
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, npz_path)
+
+    meta = {
+        "step": int(step),
+        "num_shards": int(store.num_shards),
+        "per_shard_rows": int(store.per_shard_rows),
+        "num_fields": int(store.num_fields),
+        "host_dtype": store.host.host_dtype,
+        "planes": {name: int(dim) for name, dim in store.planes.items()},
+        "vocab_rows": int(store.host.size),
+        "shard_owners": {
+            str(s): int(w) for s, w in store.map.as_dict().items()
+        },
+    }
+    meta_path = os.path.join(d, "meta.json")
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, meta_path)
+    return d
+
+
+def has_sharded_sidecar(checkpoint_dir: str, step: int) -> bool:
+    return os.path.isfile(
+        os.path.join(sharded_sidecar_dir(checkpoint_dir, step), "meta.json")
+    )
+
+
+@dataclass
+class ShardedSidecar:
+    """Loaded sharded sidecar.  `host_state` feeds
+    `HostTier.load_state_dict`; `cache_arrays` feeds
+    `ShardedTieredStore.load_cache_state`; `latest_row_values` is the
+    interface `ShardedTieredStore.rebuild_shard` consumes."""
+
+    meta: dict
+    host_state: Dict[str, np.ndarray]
+    cache_arrays: Dict[str, np.ndarray]
+
+    def host_plane(self, name: str) -> np.ndarray:
+        if self.meta["host_dtype"] == "fp32":
+            return np.asarray(self.host_state[f"plane_{name}_fp32"],
+                              np.float32)
+        return dequantize_rows_host(
+            self.host_state[f"plane_{name}_codes"],
+            self.host_state[f"plane_{name}_scales"],
+        )
+
+    def latest_row_values(self, name: str) -> np.ndarray:
+        """(vocab_rows, dim) fp32.  The sharded store's live values are
+        host-resident (per-shard caches hold only admission bookkeeping,
+        not a device value copy), so the host plane IS the freshest
+        state at save time."""
+        return self.host_plane(name).copy()
+
+
+def load_sharded_sidecar(checkpoint_dir: str, step: int) -> ShardedSidecar:
+    d = sharded_sidecar_dir(checkpoint_dir, step)
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    host_state: Dict[str, np.ndarray] = {}
+    cache_arrays: Dict[str, np.ndarray] = {}
+    with np.load(os.path.join(d, "store.npz")) as npz:
+        for key in npz.files:
+            if key.startswith("host__"):
+                host_state[key[len("host__"):]] = npz[key]
+            elif key.startswith("cache__"):
+                cache_arrays[key[len("cache__"):]] = npz[key]
+    return ShardedSidecar(meta, host_state, cache_arrays)
+
+
 def prune_sidecars(checkpoint_dir: str, keep_steps) -> None:
-    """Drop sidecars of rotated-away steps (same policy as manifests)."""
-    root = os.path.join(os.path.abspath(checkpoint_dir), SIDECAR_ROOT)
-    if not os.path.isdir(root):
-        return
+    """Drop sidecars of rotated-away steps (same policy as manifests).
+    Covers both the single-store and sharded sidecar roots."""
     keep = {str(int(s)) for s in keep_steps}
     import shutil
 
-    for name in os.listdir(root):
-        if name.isdigit() and name not in keep:
-            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    for root_name in (SIDECAR_ROOT, SHARDED_ROOT):
+        root = os.path.join(os.path.abspath(checkpoint_dir), root_name)
+        if not os.path.isdir(root):
+            continue
+        for name in os.listdir(root):
+            if name.isdigit() and name not in keep:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
 
 
 # ---- migration: tiered -> flat ----------------------------------------

@@ -131,6 +131,26 @@ def read_full_tables(state, param_paths: Dict[str, Tuple[str, ...]],
     return run_device_serialized(_read)
 
 
+def zero_cache_slots(state, param_paths: Dict[str, Tuple[str, ...]],
+                     slots: np.ndarray):
+    """Zero cache rows `slots` in every plane (and their optimizer
+    moments) — the device half of shard-handoff invalidation: a moved
+    shard's old slots must not keep serving stale values on the worker
+    that lost the shard.  Reuses the fused admission program with
+    all-zero row values."""
+    slots = np.asarray(slots, np.int32).reshape(-1)
+    if slots.size == 0:
+        return state
+    values = {
+        name: np.zeros(
+            (slots.size, int(_get_in(state.params, path).shape[1])),
+            np.float32,
+        )
+        for name, path in param_paths.items()
+    }
+    return apply_admissions(state, param_paths, slots, values)
+
+
 def apply_admissions(state, param_paths: Dict[str, Tuple[str, ...]],
                      slots: np.ndarray,
                      values: Dict[str, np.ndarray]):

@@ -254,12 +254,15 @@ class HostTier:
 
     @property
     def nbytes(self) -> int:
+        # plane arrays are allocated lazily on first growth — a store
+        # that has assigned no rows yet holds no storage at all
         with self._lock:
             total = 0
             for name in self.planes:
                 if self.host_dtype == "fp32":
-                    total += self._fp32[name][: self.vocab.size].nbytes
-                else:
+                    if name in self._fp32:
+                        total += self._fp32[name][: self.vocab.size].nbytes
+                elif name in self._codes:
                     total += self._codes[name][: self.vocab.size].nbytes
                     total += self._scales[name][: self.vocab.size].nbytes
             return total
@@ -304,6 +307,25 @@ class HostTier:
                 raise IndexError("set_rows of unassigned store row")
             for name, vals in values.items():
                 self._write_rows(name, rows, vals)
+
+    def reinit_rows(self, rows: np.ndarray) -> None:
+        """Rewrite `rows` with their deterministic seed init — the
+        shard-handoff recovery path for rows grown after the last
+        sidecar: because `row_init_values` keys on (seed, plane, row)
+        alone, the re-init is byte-identical to the value the row first
+        grew with."""
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if rows.size == 0:
+            return
+        with self._lock:
+            if int(rows.max()) >= self.vocab.size:
+                raise IndexError("reinit_rows of unassigned store row")
+            for name, dim in self.planes.items():
+                values = row_init_values(
+                    self.seed, self._plane_index[name], rows, dim,
+                    self.init_scale,
+                )
+                self._write_rows(name, rows, values)
 
     # ---- serialization -------------------------------------------------
 

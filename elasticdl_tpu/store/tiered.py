@@ -26,9 +26,14 @@ Ordering invariant: prepare() runs strictly in batch order on the ONE
 producer thread, and apply_plan()/train run strictly in batch order on
 the consumer — so plan k+1's bookkeeping always reflects plan k's
 admissions, and eviction write-backs always carry the latest trained
-value.  Multi-worker training would break this (two producers would
-interleave prepare calls), which is why client/api.py rejects tiered
-specs with num_workers != 1.
+value.  Two free-running producer threads would break this, so
+multi-worker Local training uses DEFERRED planning instead
+(`enable_deferred_prepare`): feeds attach the raw sparse batch and the
+trainer runs prepare+apply back to back at train time, under the
+ModelOwner lock that already serializes every step — strict order is
+restored at the cost of the async cold-gather overlap (docs/PERF.md
+§4).  Sharding the row space itself across workers is
+store/sharding.py's job.
 
 The stale-value hazard — a row evicted by plan k and re-admitted by
 plan k+j while its fold is still queued — is handled by the
@@ -78,6 +83,10 @@ class TieredStore:
         self.registry = registry if registry is not None else MetricsRegistry()
 
         self._lock = threading.Lock()
+        # Deferred mode (multi-worker Local path): attach() ships the raw
+        # sparse batch instead of planning eagerly; the trainer prepares
+        # AND applies at train time under the one step-serializing lock.
+        self.deferred_prepare = False
         self._pending_writeback = set()     # store rows with fold in flight
         self._gather_q: "queue.Queue" = queue.Queue()
         self._fold_q: "queue.Queue" = queue.Queue()
@@ -251,11 +260,16 @@ class TieredStore:
             self._growth.inc(n_new)
             events.emit(events.STORE_GROWN, rows=n_new,
                         vocab_rows=self.host.size)
-        if plan.prefetch_rows.size and self._started:
+        if (plan.prefetch_rows.size and self._started
+                and not self.deferred_prepare):
             self._gather_q.put(plan)
         else:
             # Nothing to prefetch (or threads not running: tests drive
             # apply_plan synchronously) — gather happens at apply time.
+            # Deferred mode lands here on purpose: apply_plan runs
+            # immediately after prepare, so bouncing the gather to the
+            # prefetcher thread buys no overlap and would miscount the
+            # wait as async; the sync gather is the honest attribution.
             plan.ready.set()
         return plan.slots, plan
 
@@ -328,17 +342,38 @@ class TieredStore:
 
     # ---- feed integration ---------------------------------------------
 
+    def enable_deferred_prepare(self) -> None:
+        """Multi-worker Local mode: move planning from the (no longer
+        unique) feed producer to the trainer's step-serialized critical
+        section.  prepare+apply then run back to back in the SAME order
+        the steps run, which restores the strict-batch-order invariant
+        with any number of producer threads — trading away the async
+        cold-gather overlap (every gather becomes a sync gather)."""
+        self.deferred_prepare = True
+
     def attach(self, batch: dict) -> dict:
         """Rewrite one feed batch: raw `sparse` ids become cache `slots`,
         and the plan rides along under `__store_plan__` (popped by the
         trainer before any tree_map sees the batch).  A feed that packed
         this batch through DedupPacker can leave the packer's ranking
         under `__dedup_ranking__` (popped here, never shipped) and the
-        admission plan reuses it."""
+        admission plan reuses it.  In deferred mode the raw sparse batch
+        (+ ranking) rides under `__store_sparse__` instead and the
+        trainer plans at train time."""
         features = dict(batch["features"])
         sparse = features.pop("sparse")
         out = dict(batch)
         ranked = out.pop("__dedup_ranking__", None)
+        if self.deferred_prepare:
+            sparse = np.asarray(sparse)
+            # Placeholder keeps the feature structure complete for
+            # model.init / export signatures; the trainer overwrites it
+            # with the real planned slots inside the step-serialized
+            # region (train_on_batch's __store_sparse__ branch).
+            features["slots"] = np.zeros(sparse.shape, np.int32)
+            out["features"] = features
+            out["__store_sparse__"] = (sparse, ranked)
+            return out
         slots, plan = self.prepare(sparse, ranked=ranked)
         features["slots"] = slots
         out["features"] = features

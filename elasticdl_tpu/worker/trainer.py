@@ -375,19 +375,24 @@ class Trainer:
         CPU backend the transfer rides inside the serialized region
         (_CPU_EXEC_LOCK), on TPU it's a plain async enqueue."""
         mesh_lib.set_current_mesh(self.mesh)
-        # A store admission plan is host bookkeeping, not batch data —
-        # pop it around the shard (tree_map would treat it as a leaf and
-        # try to device_put it), reattach on a copy after.
-        plan = batch.get("__store_plan__")
-        if plan is not None:
-            batch = {k: v for k, v in batch.items() if k != "__store_plan__"}
+        # A store admission plan (or, in deferred multi-worker mode, the
+        # raw sparse batch awaiting planning) is host bookkeeping, not
+        # batch data — pop it around the shard (tree_map would treat it
+        # as a leaf and try to device_put it), reattach on a copy after.
+        carried = {
+            k: batch[k]
+            for k in ("__store_plan__", "__store_sparse__")
+            if k in batch
+        }
+        if carried:
+            batch = {k: v for k, v in batch.items() if k not in carried}
         staged = self._timed(
             "h2d_stage", run_device_serialized,
             mesh_lib.shard_batch, batch, self.mesh,
         )
-        if plan is not None:
+        if carried:
             staged = dict(staged)
-            staged["__store_plan__"] = plan
+            staged.update(carried)
         return staged
 
     def train_on_batch(self, state, batch: Dict[str, np.ndarray]):
@@ -400,6 +405,25 @@ class Trainer:
         if plan is not None:
             batch = {k: v for k, v in batch.items() if k != "__store_plan__"}
             if self.tiered_store is not None:
+                state = self.tiered_store.apply_plan(state, plan)
+
+        # Deferred multi-worker mode: the feed shipped the raw sparse
+        # batch instead of a plan.  prepare+apply run back to back HERE,
+        # inside the step-serialized region (ModelOwner's lock), so plans
+        # are produced in exactly the order steps execute — the strict
+        # batch-order invariant holds with any number of feed producers.
+        pending = batch.get("__store_sparse__")
+        if pending is not None:
+            batch = {
+                k: v for k, v in batch.items() if k != "__store_sparse__"
+            }
+            if self.tiered_store is not None:
+                sparse, ranked = pending
+                slots, plan = self.tiered_store.prepare(sparse, ranked=ranked)
+                features = dict(batch["features"])
+                features["slots"] = slots
+                batch = dict(batch)
+                batch["features"] = features
                 state = self.tiered_store.apply_plan(state, plan)
 
         # The batch transfer rides inside the serialized region: a

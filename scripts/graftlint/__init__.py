@@ -31,6 +31,7 @@ from scripts.graftlint import (  # noqa: F401,E402
     rules_clock,
     rules_donation,
     rules_drift,
+    rules_ledger,
     rules_locks,
     rules_metrics,
     rules_quant,

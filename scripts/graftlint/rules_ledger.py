@@ -1,0 +1,69 @@
+"""GL-LEDGER: window-ledger calls must consume their acknowledgment.
+
+The exactly-once stream accounting (master/task_manager.py window
+ledger, docs/ONLINE.md) hinges on every arm/release site *reading* the
+ledger's answer:
+
+- `arm_window(...)` returns the number of tasks actually armed — 0 for
+  a duplicate arm (the re-offer after a master restart).  A caller that
+  ignores it will double-register per-window bookkeeping and count the
+  same window twice.
+- `release_window(...)` / `TaskManager.release_window` return an ack
+  bool — False means the ledger never knew the window (a lost or
+  already-released id).  Dropping the ack silently swallows the exact
+  signal the duplicate/lost-window counters exist to surface.
+
+So a *bare expression statement* calling `<x>.arm_window(...)` or
+`<x>.release_window(...)` is fire-and-forget arming and is flagged.
+Any use of the return value passes: assignment, `if`, `return`,
+comparison, f-string in a log call, `assert` (tests are not linted, but
+the fixture suite exercises it).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-LEDGER"
+
+LEDGER_METHODS = frozenset({"arm_window", "release_window"})
+
+
+def find_unconsumed_ledger_calls(tree: ast.AST):
+    """Yield (lineno, description) for every statement-level
+    `<x>.arm_window(...)` / `<x>.release_window(...)` whose return value
+    is discarded."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in LEDGER_METHODS):
+            continue
+        yield (
+            node.lineno,
+            f"{call.func.attr}(...) acknowledgment discarded — the "
+            "window ledger's return value (tasks armed / release ack) "
+            "must be consumed, or duplicate arms and lost releases go "
+            "unnoticed (docs/ONLINE.md exactly-once accounting)",
+        )
+
+
+class LedgerRule(Rule):
+    id = RULE_ID
+    title = "arm_window/release_window acknowledgments must be consumed"
+    rationale = (
+        "fire-and-forget arming double-counts re-offered windows after a "
+        "master restart and hides failed releases the lost/duplicate "
+        "counters exist to catch"
+    )
+
+    def check(self, pf: ParsedFile):
+        for lineno, message in find_unconsumed_ledger_calls(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(LedgerRule())

@@ -5,11 +5,14 @@ stream -> perpetual task queue -> train -> checkpoint -> hot-reload
 behind live predicts (docs/ONLINE.md) — and prints one
 machine-readable line:
 
-    ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b>
+    ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b> \
+        windows_armed=<a> windows_lost=<l> handoffs=<h>
 
 `scripts/run_tests.sh` emits it next to STORE_SUMMARY / TIER1_SUMMARY
-so CI can watch the online loop's sustained throughput and
-train-to-serve staleness drift without running the full bench
+so CI can watch the online loop's sustained throughput,
+train-to-serve staleness drift, and the window-ledger health
+(armed/lost counts plus shard handoffs — lost must stay 0; see
+docs/ONLINE.md exactly-once accounting) without running the full bench
 (`python bench.py --online`).  A few seconds on CPU: two windows, two
 in-process replicas, sequential predicts on the driver thread.
 
@@ -90,6 +93,9 @@ def smoke_summary(windows: int = WINDOWS,
         "failed_requests": failed,
         "windows_trained": snap["windows_trained"],
         "last_reload_step": snap["online"]["last_reload_step"],
+        "windows_armed": snap["online"]["windows_armed"],
+        "windows_lost": snap["online"]["windows_lost"],
+        "handoffs": snap["online"]["handoffs"],
     }
 
 
@@ -97,11 +103,16 @@ def main() -> int:
     summary = smoke_summary()
     print(
         "ONLINE_SUMMARY train_eps={eps:.1f} qps={qps:.1f} "
-        "staleness_p99_s={stale:.4f} burn={burn:.3f}".format(
+        "staleness_p99_s={stale:.4f} burn={burn:.3f} "
+        "windows_armed={armed} windows_lost={lost} "
+        "handoffs={handoffs}".format(
             eps=summary["train_eps"],
             qps=summary["qps"],
             stale=summary["staleness_p99_s"],
             burn=summary["burn"],
+            armed=summary["windows_armed"],
+            lost=summary["windows_lost"],
+            handoffs=summary["handoffs"],
         )
     )
     return 0

@@ -28,6 +28,7 @@ from scripts.graftlint import (  # noqa: E402
     rules_clock,
     rules_donation,
     rules_drift,
+    rules_ledger,
     rules_locks,
     rules_metrics,
     rules_quant,
@@ -36,7 +37,7 @@ from scripts.graftlint import (  # noqa: E402
 
 ALL_IDS = {
     "GL-BOUNDARY", "GL-CLOCK", "GL-DONATE", "GL-DRIFT",
-    "GL-LOCK", "GL-METRIC", "GL-QUANT", "GL-RETRY",
+    "GL-LEDGER", "GL-LOCK", "GL-METRIC", "GL-QUANT", "GL-RETRY",
 }
 
 
@@ -47,7 +48,7 @@ def _ids(findings):
 # ---- framework ----------------------------------------------------------
 
 
-def test_registry_has_all_eight_rules():
+def test_registry_has_all_nine_rules():
     assert set(core.all_rules()) == ALL_IDS
 
 
@@ -125,6 +126,53 @@ def test_retry_router_fanout_positive():
     found = check_source(src, "elasticdl_tpu/proto/x.py",
                          [rules_retries.RetryRule()])
     assert _ids(found) == ["GL-RETRY"]
+
+
+# ---- GL-LEDGER ----------------------------------------------------------
+
+FIRE_AND_FORGET_ARM = (
+    "def offer(tm, window):\n"
+    "    tm.arm_window(window.name, window.records, 4,\n"
+    "                  window_id=window.window_id)\n"
+)
+
+
+def test_ledger_bare_arm_is_flagged():
+    found = check_source(FIRE_AND_FORGET_ARM, "elasticdl_tpu/online/x.py",
+                         [rules_ledger.LedgerRule()])
+    assert _ids(found) == ["GL-LEDGER"]
+    assert found[0].line == 2
+    assert "arm_window" in found[0].message
+
+
+def test_ledger_bare_release_is_flagged():
+    src = "def done(tm, wid):\n    tm.release_window(wid)\n"
+    found = check_source(src, "elasticdl_tpu/online/x.py",
+                         [rules_ledger.LedgerRule()])
+    assert _ids(found) == ["GL-LEDGER"]
+    assert "release_window" in found[0].message
+
+
+def test_ledger_consumed_ack_passes():
+    src = (
+        "def offer(tm, reader, window):\n"
+        "    n = tm.arm_window(window.name, window.records, 4)\n"
+        "    if n and not reader.release_window(window.name):\n"
+        "        raise RuntimeError('unacked release')\n"
+        "    return n\n"
+    )
+    assert not check_source(src, "elasticdl_tpu/online/x.py",
+                            [rules_ledger.LedgerRule()])
+
+
+def test_ledger_suppressed():
+    src = FIRE_AND_FORGET_ARM.replace(
+        "tm.arm_window(window.name, window.records, 4,",
+        "tm.arm_window(window.name, window.records, 4,"
+        "  # graftlint: disable=GL-LEDGER",
+    )
+    assert not check_source(src, "elasticdl_tpu/online/x.py",
+                            [rules_ledger.LedgerRule()])
 
 
 # ---- GL-BOUNDARY --------------------------------------------------------

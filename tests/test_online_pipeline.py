@@ -9,6 +9,8 @@ line and stream-lag coverage from the snapshot."""
 import numpy as np
 import pytest
 
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
 from elasticdl_tpu.client.slo import render_slo
 from elasticdl_tpu.client.top import render as top_render
 from elasticdl_tpu.common.model_handler import get_model_spec
@@ -107,9 +109,11 @@ def test_loop_measures_staleness_and_stream_lag(loop_result):
 
 def test_chaos_replay_is_byte_identical():
     """Same-seed chaos runs — stream.poll stall, task.rearm loss,
-    serving.reload rejection, mid-run replica kill — produce identical
-    fault traces, fleet/SLO decision lists, and event streams, with all
-    scheduled faults fired and zero failed predicts (docs/ONLINE.md
+    store.shard_handoff deferral, serving.reload rejection, a mid-run
+    replica kill, TWO trainer kills, and a full master restart — produce
+    identical fault traces, fleet/SLO decision lists, and event streams,
+    with all scheduled faults fired, zero failed predicts, zero lost
+    windows, and zero duplicated window offsets (docs/ONLINE.md
     "Determinism under chaos")."""
     import bench
 
@@ -122,6 +126,73 @@ def test_chaos_replay_is_byte_identical():
     assert summary_a["rearm_faults"] == 1
     assert summary_a["poll_faults"] == 1
     assert summary_a["windows_trained"] >= 2
+    # the elastic acceptance gate: exactly-once window accounting held
+    # through both trainer kills and the master restart
+    assert summary_a["master_restarts"] == 1
+    assert summary_a["windows_lost"] == 0
+    assert summary_a["duplicate_reports"] == 0
+    assert summary_a["windows_released"] == summary_a["windows_trained"]
+    assert summary_a["handoffs"] >= 1
+    assert summary_a["handoff_faults"] == 1
+
+
+def test_three_worker_pipeline_survives_kill_and_master_restart(
+    spec, tmp_path
+):
+    """The satellite acceptance run: 3 logical trainers over a 4-shard
+    store; one trainer dies with its shard evacuation FAULTED (deferred),
+    the master restarts with a window mid-flight, a second trainer dies
+    (draining the deferred move), and the loop finishes with zero lost
+    and zero duplicated windows."""
+    clk = [2_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    cfg = OnlineConfig(
+        seed=9, window_records=32, records_per_poll=32,
+        records_per_task=8, checkpoint_every_windows=2, replicas=1,
+        workers=3, num_shards=4, store_cache_rows=64,
+    )
+    pipe = OnlinePipeline(str(tmp_path), spec, cfg, clock=clock)
+    faults.install(FaultRegistry(schedule=[
+        FaultSpec(faults.POINT_STORE_SHARD_HANDOFF, 0, "raise"),
+    ], seed=9))
+    try:
+        for i in range(6):
+            if i == 3:
+                # leave the tick's window partially trained, then lose
+                # the master: the journal must re-arm only the remainder
+                pipe.tick(max_train_tasks=1)
+                restored = pipe.restart_master()
+                continue
+            pipe.tick()
+            if i == 2:
+                killed = pipe.kill_worker(1)   # its one shard move defers
+            if i == 4:
+                pipe.kill_worker(2)            # drains the deferred move
+        pipe.tick()                            # train the re-armed rest
+    finally:
+        faults.uninstall()
+    assert killed["handoffs"] == 0             # the injected deferral
+    assert restored["windows_restored"] == 1
+    assert restored["tasks_rearmed"] == 3      # 4 tasks/window, 1 done
+    snap = pipe.snapshot()
+    online = snap["online"]
+    assert online["windows_lost"] == 0
+    assert online["duplicate_reports"] == 0
+    assert online["open_windows"] == 0         # every window released
+    assert online["handoffs"] == 2             # both kills' shards moved
+    assert online["pending_handoffs"] == 0
+    assert snap["store"]["handoff_faults"] == 1
+    assert snap["trainers"]["alive"] == [0]    # the lone survivor
+    assert snap["trainers"]["master_restarts"] == 1
+    # every shard evacuated onto the lone survivor
+    assert set(snap["store"]["shard_owners"].values()) == {0}
+    with pytest.raises(ValueError):
+        pipe.kill_worker(0)                    # never kill the last one
+    pipe.shutdown()
 
 
 def test_top_renders_online_line(loop_result):
@@ -166,3 +237,8 @@ def test_online_summary_matches_script():
     assert summary["train_eps"] > 0
     assert summary["qps"] > 0
     assert summary["staleness_p99_s"] >= 0.0
+    # window-ledger health keys behind the CI line's windows_armed= /
+    # windows_lost= / handoffs= fields
+    assert summary["windows_armed"] >= summary["windows_trained"]
+    assert summary["windows_lost"] == 0
+    assert summary["handoffs"] == 0  # single-worker smoke: no handoffs

@@ -1,0 +1,195 @@
+"""Sharded tiered store unit coverage: deterministic shard-map
+rebalancing, per-shard admission planning partitioned from the
+batch-global frequency ranking, the stats-plane fold, the
+`store.shard_handoff` fault point's defer/retry semantics, and host
+rebuild from the sharded checkpoint sidecar (docs/ONLINE.md "Sharded
+store + elastic trainer pool", docs/ROBUSTNESS.md)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.store import checkpoint as store_checkpoint
+from elasticdl_tpu.store.sharding import ShardedTieredStore, ShardMap
+
+
+def make_store(num_shards=4, workers=(0, 1, 2), cache_rows=16, **kw):
+    return ShardedTieredStore(
+        planes={"ctr": 2}, num_fields=2, cache_rows=cache_rows,
+        num_shards=num_shards, workers=workers, **kw,
+    )
+
+
+def batch(pairs):
+    return np.asarray(pairs, np.int64)
+
+
+# ---- ShardMap -----------------------------------------------------------
+
+
+def test_shardmap_round_robin_assignment():
+    m = ShardMap(4, [0, 1, 2])
+    assert m.as_dict() == {0: 0, 1: 1, 2: 2, 3: 0}
+    assert m.worker_shards(0) == [0, 3]
+    assert m.workers() == [0, 1, 2]
+    assert list(m.shard_of_rows(np.arange(8))) == [0, 1, 2, 3, 0, 1, 2, 3]
+
+
+def test_shardmap_remove_returns_evacuations_and_guards_last_worker():
+    m = ShardMap(4, [0, 1, 2])
+    assert m.remove_worker(1) == [1]
+    assert m.workers() == [0, 2]
+    # owner unchanged until the move applies — the evacuation is pending
+    assert m.owner(1) == 1
+    assert m.remove_worker(1) == []        # idempotent
+    assert m.remove_worker(2) == [2]
+    with pytest.raises(ValueError):
+        m.remove_worker(0)
+
+
+def test_shardmap_least_loaded_ignores_unregistered_owners():
+    """A dead worker still named by a pending move's shard must never be
+    picked as a handoff target."""
+    m = ShardMap(4, [0, 1, 2])
+    m.remove_worker(2)                     # shard 2 still owned by corpse 2
+    assert m.least_loaded() in (0, 1)
+    for _ in range(4):
+        assert m.least_loaded() != 2
+
+
+def test_shardmap_add_worker_takes_fair_share_from_most_loaded():
+    m = ShardMap(4, [0, 1])                # 0 -> {0, 2}, 1 -> {1, 3}
+    shards = m.add_worker(5)
+    assert len(shards) == 4 // 3           # fair share rounds down
+    assert m.workers() == [0, 1, 5]
+    assert m.add_worker(5) == []           # idempotent
+    # two same-shaped maps rebalance identically (chaos byte-stability)
+    n = ShardMap(4, [0, 1])
+    assert n.add_worker(5) == shards
+
+
+# ---- admission planning -------------------------------------------------
+
+
+def test_prepare_slots_stay_inside_the_owning_shard_slice():
+    store = make_store(num_shards=4, cache_rows=16)   # 4 rows per shard
+    sparse = batch([[0, 1], [2, 3], [4, 5], [0, 1]])
+    plan = store.prepare(sparse)
+    assert plan.slots.shape == sparse.shape
+    assert plan.growth == store.host.size > 0
+    flat_slots = plan.slots.reshape(-1).astype(np.int64)
+    flat_rows = plan.rows.reshape(-1)
+    # global slot = shard * per_shard_rows + local slot
+    np.testing.assert_array_equal(
+        flat_slots // store.per_shard_rows, flat_rows % store.num_shards
+    )
+    assert sum(plan.by_shard.values()) == sparse.size
+
+
+def test_prepare_second_pass_is_all_hits():
+    store = make_store()
+    sparse = batch([[0, 1], [2, 3]])
+    first = store.prepare(sparse)
+    assert first.misses == len(set(first.rows.reshape(-1).tolist()))
+    second = store.prepare(sparse)
+    assert second.misses == 0
+    assert second.hits == sparse.size
+    np.testing.assert_array_equal(first.slots, second.slots)
+    assert store.stats()["hit_rate"] > 0
+
+
+def test_fold_stats_accumulates_impressions_and_clicks():
+    store = make_store()
+    plan = store.prepare(batch([[0, 1], [0, 1]]))
+    rows = plan.rows
+    uniq = np.unique(rows.reshape(-1))
+    init = store.host.gather(uniq, planes=("ctr",))["ctr"].copy()
+    clicked = np.array([1.0, 0.0], np.float32)
+    store.fold_stats(rows, np.repeat(clicked, rows.shape[1]))
+    store.fold_stats(rows, np.repeat(clicked, rows.shape[1]))
+    delta = store.host.gather(uniq, planes=("ctr",))["ctr"] - init
+    # each unique row was looked up twice per fold, two folds
+    np.testing.assert_allclose(delta[:, 0], 4.0, rtol=1e-6)
+    # clicks only from the clicked=1 half of the batch
+    assert delta[:, 1].sum() == pytest.approx(4.0)
+
+
+# ---- shard handoff ------------------------------------------------------
+
+
+def test_handoff_reassigns_dead_workers_shards_and_emits():
+    store = make_store(num_shards=4, workers=(0, 1, 2))
+    seen = []
+    observe = lambda record: seen.append(record)
+    events.add_observer(observe)
+    try:
+        moves = store.handoff(dead_worker=0)   # owned shards 0 and 3
+    finally:
+        events.remove_observer(observe)
+    assert [(s, old) for s, old, _ in moves] == [(0, 0), (3, 0)]
+    assert all(new in (1, 2) for _, _, new in moves)
+    owners = set(store.map.as_dict().values())
+    assert 0 not in owners
+    handoff_events = [
+        r for r in seen if r.get("event") == "store_shard_handoff"
+    ]
+    assert len(handoff_events) == 2
+    assert store.stats()["handoffs"] == 2
+    assert store.pending_handoffs() == 0
+
+
+def test_handoff_fault_defers_one_move_and_the_next_call_retries():
+    store = make_store(num_shards=4, workers=(0, 1, 2))
+    faults.install(FaultRegistry(schedule=[
+        FaultSpec(faults.POINT_STORE_SHARD_HANDOFF, 0, "raise"),
+    ], seed=13))
+    try:
+        moves = store.handoff(dead_worker=0)
+        # first move (shard 0) deferred, second (shard 3) completed
+        assert [s for s, _, _ in moves] == [3]
+        assert store.pending_handoffs() == 1
+        assert store.stats()["handoff_faults"] == 1
+        assert store.map.owner(0) == 0     # corpse still recorded as owner
+        retried = store.handoff()          # no new death: drain pending
+        assert [(s, old) for s, old, _ in retried] == [(0, 0)]
+        assert retried[0][2] != 0          # never handed back to the corpse
+    finally:
+        faults.uninstall()
+    assert store.pending_handoffs() == 0
+    assert store.stats()["handoffs"] == 2
+
+
+def test_join_rebalances_toward_the_new_worker():
+    store = make_store(num_shards=4, workers=(0, 1))
+    moves = store.join(7)
+    assert len(moves) == 1
+    assert all(new == 7 for _, _, new in moves)
+    assert 7 in store.map.workers()
+
+
+# ---- sidecar rebuild ----------------------------------------------------
+
+
+def test_rebuild_shard_from_sidecar_plus_deterministic_backfill(tmp_path):
+    store = make_store(num_shards=2, workers=(0, 1), cache_rows=8)
+    plan = store.prepare(batch([[0, 1], [2, 3]]))
+    store.fold_stats(plan.rows, np.ones(plan.rows.size, np.float32))
+    store_checkpoint.save_sharded_sidecar(str(tmp_path), 5, store)
+    sidecar = store_checkpoint.load_sharded_sidecar(str(tmp_path), 5)
+    assert sidecar.meta["vocab_rows"] == store.host.size
+
+    # rows grown AFTER the save are beyond the sidecar's coverage
+    store.prepare(batch([[9, 9], [10, 10]]))
+    for shard in range(store.num_shards):
+        rows = store.shard_rows(shard)
+        expect = store.host.gather(rows, planes=("ctr",))["ctr"].copy()
+        # corrupt the shard's host slice (what a lost host copy models)
+        store.host.set_rows(rows, {"ctr": np.zeros_like(expect)})
+        rebuilt = store.rebuild_shard(shard, sidecar)
+        assert rebuilt == rows.size
+        got = store.host.gather(rows, planes=("ctr",))["ctr"]
+        # sidecar values for covered rows, byte-identical deterministic
+        # re-init for rows grown since (host_tier.row_init_values keys
+        # on the row index alone)
+        np.testing.assert_array_equal(got, expect)

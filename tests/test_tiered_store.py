@@ -803,3 +803,39 @@ def test_local_run_starts_store_background_threads(tmp_path):
     assert stats["vocab_rows"] == stats["growth_rows"]
     assert stats["cold_gather_overlap_share"] > 0.0
     assert not store._started  # runner stopped the threads at job end
+
+
+def test_local_multiworker_run_uses_deferred_planning(tmp_path):
+    """The lifted num_workers>1 rejection, end to end: two feed
+    producers over one tiered store via deferred planning (PERF.md §4).
+    The deferred feed must still ship a complete feature structure —
+    model.init sees a placeholder `slots` the trainer later overwrites —
+    and every cold gather runs sync inside the step-serialized region
+    (overlap share exactly 0, the honest attribution)."""
+    from elasticdl_tpu.client.main import main as cli_main
+    from model_zoo.deepfm.data import write_dataset
+
+    train_dir, _val_dir = write_dataset(
+        str(tmp_path / "data"), n_train=512, n_val=64
+    )
+    rc = cli_main(
+        [
+            "train",
+            "--model_zoo", "model_zoo",
+            "--model_def", "deepfm.deepfm_tiered.custom_model",
+            "--model_params", "cache_rows=2048;embed_dim=4",
+            "--training_data", train_dir,
+            "--distribution_strategy", "Local",
+            "--num_epochs", "1",
+            "--minibatch_size", "64",
+            "--records_per_task", "128",
+            "--num_workers", "2",
+        ]
+    )
+    assert rc == 0
+    store = sys.modules["deepfm.deepfm_tiered"]._LAST_STORE
+    assert store.deferred_prepare
+    stats = store.stats()
+    assert stats["growth_rows"] > 0
+    assert stats["hit_rate"] > 0.5
+    assert stats["cold_gather_overlap_share"] == 0.0
