@@ -1460,6 +1460,211 @@ def bench_online(
     }
 
 
+def _traffic_spike_run(seed: int, ticks: int = 44,
+                       capacity_per_tick: int = 12):
+    """One seeded pass of the serving control loop under a FAKE clock:
+    the replayable traffic generator offers a 5x spike at an autoscaling
+    fleet whose replicas each serve `capacity_per_tick` requests per
+    generator tick (the capacity gate models a replica's finite
+    throughput — the real in-process engine answers everything a
+    sequential driver offers, so overload has to be declared, not
+    discovered).  Returns (canonical_text, summary): the text is the
+    offered schedule + serving-scale decision list + fleet-size trace +
+    normalized scale/SLO events, byte-identical across same-seed runs.
+
+    The loop under test (docs/SERVING.md "Autoscaling & backpressure"):
+    spike -> whole-fleet sheds -> predict_shed_ratio SLO burns -> the
+    flight recorder captures an incident bundle at the breach -> the
+    serving policy engine scales up within its hysteresis window ->
+    serving_pressure slows the pipeline's poll/arm cadence -> spike
+    passes, evidence ages out of the shed window -> the fleet scales
+    back to min."""
+    import tempfile
+
+    from elasticdl_tpu.common import events as events_lib
+    from elasticdl_tpu.common.flight import FlightRecorder
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+    from elasticdl_tpu.proto import serving_pb2 as spb
+    from elasticdl_tpu.traffic import (
+        TrafficConfig,
+        TrafficGenerator,
+        router_request_fn,
+    )
+    from model_zoo.clickstream import ctr_mlp
+
+    clk = [2_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    class _CapacityGate:
+        """Per-tick admission control in front of a real replica: the
+        first `capacity_per_tick` requests pass through, the rest shed
+        with SERVING_OVERLOADED — exactly the response a saturated
+        batcher queue sends."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.used = 0
+
+        def reset(self):
+            self.used = 0
+
+        def predict(self, request, timeout=None):
+            if self.used >= capacity_per_tick:
+                response = spb.PredictResponse()
+                response.code = spb.SERVING_OVERLOADED
+                response.error = "per-tick capacity exhausted"
+                return response
+            self.used += 1
+            return self._inner.predict(request, timeout=timeout)
+
+        def health(self, request, timeout=None):
+            return self._inner.health(request, timeout=timeout)
+
+    gates = {}
+
+    def client_wrapper(rid, inner):
+        gates[rid] = _CapacityGate(inner)
+        return gates[rid]
+
+    # Clock-free projection of the decision-bearing events: enough to
+    # pin the control loop's story, nothing that varies run to run.
+    keep = ("action", "reason", "tick", "requested", "replicas",
+            "slo", "state")
+    watched = (
+        events_lib.SERVING_SCALE, events_lib.SLO_BREACH,
+        events_lib.SLO_RECOVERED, events_lib.INCIDENT_CAPTURED,
+    )
+    norm_events = []
+
+    def observe(record):
+        if record.get("event") in watched:
+            norm_events.append({
+                "event": record["event"],
+                **{k: record[k] for k in keep if k in record},
+            })
+
+    events_lib.add_observer(observe)
+    try:
+        spec = get_model_spec(_ZOO, "clickstream.ctr_mlp.custom_model")
+        with tempfile.TemporaryDirectory() as tmp:
+            incident_dir = os.path.join(tmp, "incidents")
+            pipe = OnlinePipeline(
+                tmp, spec,
+                OnlineConfig(
+                    seed=seed, window_records=64, records_per_poll=64,
+                    records_per_task=16, checkpoint_every_windows=2,
+                    replicas=1, max_serving_replicas=4,
+                    serving_up_ticks=2, serving_down_ticks=3,
+                    serving_scale_hold_ticks=2,
+                    serving_shed_window_s=30.0,
+                    backpressure_threshold=0.25,
+                    backpressure_stride=4,
+                ),
+                clock=clock,
+                client_wrapper=client_wrapper,
+            )
+            recorder = FlightRecorder(
+                incident_dir=incident_dir,
+                snapshot_fn=pipe.snapshot,
+                history=pipe.history,
+            ).install()
+            pipe.evaluator.set_on_breach(recorder.breach)
+
+            def encode_fn(rows, payload_seed):
+                rng = np.random.RandomState(payload_seed % (2 ** 31))
+                return ctr_mlp.encode(
+                    rng.randint(0, 512, rows), rng.randint(0, 128, rows)
+                )
+
+            gen = TrafficGenerator(
+                router_request_fn(pipe.router, encode_fn),
+                TrafficConfig(
+                    profile="spike", base_qps=8.0, clients=4, seed=seed,
+                    tick_interval_s=1.0, spike_at_tick=8, spike_ticks=4,
+                    spike_factor=5.0,
+                ),
+            )
+            fleet_sizes, pressures = [], []
+            try:
+                for _ in range(ticks):
+                    for gate in gates.values():
+                        gate.reset()
+                    gen.tick()
+                    pipe.tick()
+                    fleet_sizes.append(pipe.fleet_manager.live_replicas())
+                    pressures.append(pipe._serving_pressure)
+                snap = pipe.snapshot()
+                traffic = gen.snapshot()
+                recorder.flush()
+                bundles = (
+                    sorted(os.listdir(incident_dir))
+                    if os.path.isdir(incident_dir) else []
+                )
+            finally:
+                recorder.close()
+                pipe.shutdown()
+    finally:
+        events_lib.remove_observer(observe)
+
+    policy = snap["serving_policy"]
+    canonical = json.dumps({
+        "schedule": traffic["schedule"],
+        "decisions": policy["decisions"],
+        "fleet_sizes": fleet_sizes,
+        "events": norm_events,
+        "bundles": bundles,
+    }, sort_keys=True)
+    summary = {
+        "offered": traffic["offered"],
+        "offered_qps": traffic["offered_qps"],
+        "ok": traffic["ok"],
+        "shed": traffic["shed"],
+        "failed_requests": traffic["failed"],
+        "shed_ratio": traffic["shed_ratio"],
+        "min_fleet": 1,
+        "peak_fleet": max(fleet_sizes),
+        "final_fleet": fleet_sizes[-1],
+        "scale_ups": snap["serving_fleet"]["scale_ups"],
+        "scale_downs": snap["serving_fleet"]["scale_downs"],
+        "decisions": len(policy["decisions"]),
+        "polls_skipped": snap["backpressure"]["polls_skipped"],
+        "peak_pressure": round(max(pressures), 4),
+        "incident_bundles": bundles,
+        "max_burn_rate": round(snap["max_burn"], 3),
+    }
+    return canonical, summary
+
+
+def bench_traffic(seed: int = 20260807):
+    """Serving control-loop bench (`python bench.py --traffic`): the
+    seeded 5x spike scenario, run twice to pin byte-stability.  The
+    headline value is the offered spike load absorbed without a single
+    failed request while the fleet autoscales."""
+    trace_a, summary_a = _traffic_spike_run(seed)
+    trace_b, summary_b = _traffic_spike_run(seed)
+    return {
+        "bench": "traffic",
+        "value": summary_a["offered_qps"],
+        "unit": "offered_qps",
+        "detail": {
+            "seed": seed,
+            "deterministic": trace_a == trace_b,
+            "spike_absorbed": summary_a["failed_requests"] == 0,
+            "scaled_up": summary_a["peak_fleet"] > summary_a["min_fleet"],
+            "returned_to_min":
+                summary_a["final_fleet"] == summary_a["min_fleet"],
+            "incident_captured":
+                len(summary_a["incident_bundles"]) > 0,
+            "backpressure_engaged": summary_a["polls_skipped"] > 0,
+            **summary_a,
+        },
+    }
+
+
 def bench_sparse_path(batch_size: int = 65536):
     """Sparse-path economics (`python bench.py --sparse-path`):
 
@@ -2065,6 +2270,7 @@ def main():
               "serving-fleet": bench_serving_fleet,
               "serving_fleet": bench_serving_fleet,
               "online": bench_online,
+              "traffic": bench_traffic,
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
               "tiered": bench_tiered,

@@ -134,6 +134,26 @@ def render(varz: dict, serving_varz: Optional[dict] = None,
             f"skew={fleet.get('model_step_skew', 0)}"
             f"/slo={slo if slo else '-'}"
         )
+    serving_policy = snapshot.get("serving_policy")
+    if serving_policy:
+        last = serving_policy.get("last_decision")
+        last_text = (
+            f" last={last['action']}/{last['reason']}@t{last['tick']}"
+            if last else ""
+        )
+        offered = metrics.get("traffic_offered_per_sec")
+        offered_text = (
+            f"offered={offered:.1f}/s " if offered is not None else ""
+        )
+        lines.append(
+            f"traffic: {offered_text}"
+            f"shed_ratio={serving_policy.get('shed_ratio', 0.0):.3f} "
+            f"burn={serving_policy.get('burn', 0.0):.2f}x "
+            f"fleet={serving_policy.get('live_replicas', 0)}"
+            f"[{serving_policy.get('min_replicas', 0)}"
+            f"-{serving_policy.get('max_replicas', 0)}]"
+            f" hold={serving_policy.get('hold_ticks', 0)}{last_text}"
+        )
     slo = snapshot.get("slo")
     if slo:
         states = slo.get("states", {})

@@ -254,6 +254,81 @@ def add_common_params(parser: argparse.ArgumentParser):
         help="gRPC port each serving replica listens on (the fleet "
         "manager probes {replica-service}:{this port}).",
     )
+    # ---- serving autoscaler + backpressure (master/policy.py
+    #      ServingPolicyEngine, docs/SERVING.md "Autoscaling &
+    #      backpressure") ----
+    parser.add_argument(
+        "--max_serving_replicas", type=non_neg_int, default=0,
+        help="Upper bound the serving policy engine may scale the fleet "
+        "to.  0 (the default) disables serving autoscaling entirely; "
+        "the fleet stays at --serving_replicas.",
+    )
+    parser.add_argument(
+        "--min_serving_replicas", type=non_neg_int, default=0,
+        help="Lower bound the serving policy engine may scale the fleet "
+        "down to.  0 defaults to --serving_replicas (the placed size).",
+    )
+    parser.add_argument(
+        "--serving_policy_interval", type=float, default=0.0,
+        help="Seconds between serving policy engine ticks (SLO burn / "
+        "shed-ratio / batch-fill signals -> at most one scale action).  "
+        "0 disables the background loop; tests tick by hand.",
+    )
+    parser.add_argument(
+        "--serving_burn_threshold", type=float, default=1.0,
+        help="Fast-window SLO burn rate at or above which a serving "
+        "scale-up streak accrues (1.0 = spending exactly the error "
+        "budget).",
+    )
+    parser.add_argument(
+        "--serving_shed_threshold", type=float, default=0.02,
+        help="Windowed whole-fleet shed ratio at or above which a "
+        "serving scale-up streak accrues (capacity exhaustion evidence "
+        "even before an SLO burns).",
+    )
+    parser.add_argument(
+        "--serving_fill_low", type=float, default=0.2,
+        help="Mean healthy-replica batch fill at or below which a calm "
+        "fleet accrues a scale-down streak (paying for replicas the "
+        "batcher cannot fill).",
+    )
+    parser.add_argument(
+        "--serving_up_ticks", type=pos_int, default=2,
+        help="Consecutive overloaded ticks before the serving policy "
+        "engine scales up (hysteresis entry gate).",
+    )
+    parser.add_argument(
+        "--serving_down_ticks", type=pos_int, default=3,
+        help="Consecutive calm, underfilled ticks before the serving "
+        "policy engine scales down.",
+    )
+    parser.add_argument(
+        "--serving_scale_step", type=pos_int, default=1,
+        help="Replicas added or retired per serving scale action.",
+    )
+    parser.add_argument(
+        "--serving_scale_hold_ticks", type=non_neg_int, default=2,
+        help="Quiet ticks after any serving scale action before the "
+        "next one — the fleet must re-converge (probe, warm, drain) "
+        "before the signals mean anything again.",
+    )
+    parser.add_argument(
+        "--serving_shed_window_s", type=float, default=30.0,
+        help="Metric-history window the serving policy engine computes "
+        "its shed ratio over (a past spike ages out of the evidence).",
+    )
+    parser.add_argument(
+        "--backpressure_threshold", type=float, default=0.25,
+        help="serving_pressure (SLO burn rate x fleet shed ratio) above "
+        "which the online pipeline slows its stream poll/arm cadence — "
+        "train yields to serve until the pressure clears.",
+    )
+    parser.add_argument(
+        "--backpressure_stride", type=pos_int, default=4,
+        help="While backpressured, the online pipeline polls/arms only "
+        "every this-many-th tick (queued tasks still drain every "
+        "tick).",
+    )
     # ---- metric history + SLOs (common/history.py, common/slo.py,
     #      docs/OBSERVABILITY.md "Metric history & SLOs") ----
     parser.add_argument(

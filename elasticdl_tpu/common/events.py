@@ -58,6 +58,7 @@ STREAM_WINDOW_DROPPED = "stream_window_dropped"  # bounded buffer lost one
 STREAM_WINDOW_RELEASED = "stream_window_released"  # ledger acked trained
 STREAM_WINDOW_RESTORED = "stream_window_restored"  # un-acked replayed
 STORE_SHARD_HANDOFF = "store_shard_handoff"  # row range moved to successor
+SERVING_SCALE = "serving_scale"    # serving policy engine scaled the fleet
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -72,6 +73,7 @@ VOCABULARY = frozenset({
     INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
     STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED, STREAM_WINDOW_DROPPED,
     STREAM_WINDOW_RELEASED, STREAM_WINDOW_RESTORED, STORE_SHARD_HANDOFF,
+    SERVING_SCALE,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every
@@ -82,6 +84,20 @@ VOCABULARY = frozenset({
 POLICY_ACTIONS = frozenset({"evict", "scale_up", "scale_down"})
 POLICY_REASONS = frozenset({
     "straggler", "backlog", "data_wait", "stream_lag",
+})
+
+#: Closed vocabularies for the `action` / `reason` fields every
+#: SERVING_SCALE event must carry (enforced at emit time by
+#: master/policy.py's ServingPolicyEngine and statically by graftlint
+#: GL-METRIC rule 4, same contract as POLICY_DECISION).  `scale_aborted`
+#: records an action the fleet.scale fault point aborted — the engine
+#: retries it next tick with its streaks frozen.
+SERVING_SCALE_ACTIONS = frozenset({
+    "scale_up", "scale_down", "scale_aborted",
+})
+SERVING_SCALE_REASONS = frozenset({
+    "burn_rate", "shed_ratio", "batch_fill", "idle", "reload_guard",
+    "fault",
 })
 
 #: Closed vocabularies for the serve-path PREDICT_SPAN event

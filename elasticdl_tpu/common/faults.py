@@ -64,6 +64,13 @@ POINT_TASK_REARM = "task.rearm"
 # mid-move leaves the shard orphaned until the next retry — exactly the
 # window the chaos soak aims at.
 POINT_STORE_SHARD_HANDOFF = "store.shard_handoff"
+# Serving control-loop boundaries (traffic/generator.py +
+# master/serving_fleet.py scale paths): a traffic tick that dies must
+# not corrupt the offered-request schedule, and an apiserver error
+# mid-scale must abort the whole action atomically — the serving policy
+# engine retries it next tick with its streaks frozen.
+POINT_TRAFFIC_TICK = "traffic.tick"
+POINT_FLEET_SCALE = "fleet.scale"
 
 POINTS = (
     POINT_RPC_GET_TASK,
@@ -83,6 +90,8 @@ POINTS = (
     POINT_STREAM_POLL,
     POINT_TASK_REARM,
     POINT_STORE_SHARD_HANDOFF,
+    POINT_TRAFFIC_TICK,
+    POINT_FLEET_SCALE,
 )
 
 ACTIONS = ("raise", "delay", "drop")

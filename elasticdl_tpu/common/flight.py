@@ -71,6 +71,7 @@ DECISION_EVENTS = frozenset({
     events.FLEET_RELOAD_REFUSED,
     events.SLO_BREACH,
     events.SLO_RECOVERED,
+    events.SERVING_SCALE,
 })
 
 

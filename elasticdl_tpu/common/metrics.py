@@ -38,7 +38,7 @@ _LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 # First `_`-separated token of every metric name.
 KNOWN_SUBSYSTEMS = frozenset(
     {"master", "worker", "serving", "data", "rpc", "faults", "process",
-     "store"}
+     "store", "traffic"}
 )
 
 # Trailing unit token(s).  `_total` marks counters (Prometheus convention),

@@ -53,11 +53,13 @@ logger = get_logger(__name__)
 SLO_STALENESS_P99 = "staleness_p99"
 SLO_FLEET_SKEW = "fleet_skew"
 SLO_PREDICT_AVAILABILITY = "predict_availability"
+SLO_PREDICT_SHED_RATIO = "predict_shed_ratio"
 
 SLO_NAMES = frozenset({
     SLO_STALENESS_P99,
     SLO_FLEET_SKEW,
     SLO_PREDICT_AVAILABILITY,
+    SLO_PREDICT_SHED_RATIO,
 })
 
 STATE_NO_DATA = "no_data"
@@ -116,6 +118,20 @@ def shipped_specs(args=None) -> List[SloSpec]:
             total_series="rpc_fleet_requests_total",
             objective=0.0,
             target=0.999,
+        ),
+        # A whole-fleet shed is a request the caller did not get served
+        # even though no replica errored — admission control answering
+        # for everyone.  Distinct from availability (errors) because the
+        # remediation differs: sheds want capacity (the serving policy
+        # engine scales on this burn), errors want repair.
+        SloSpec(
+            name=SLO_PREDICT_SHED_RATIO,
+            kind="ratio",
+            series="rpc_fleet_sheds_total",
+            total_series="rpc_fleet_requests_total",
+            objective=0.0,
+            target=0.95,
+            fast_burn=8.0,
         ),
     ]
 
@@ -320,6 +336,22 @@ class SloEvaluator:
                 (row.get("fast_burn", 0.0) for row in self._last.values()),
                 default=0.0,
             )
+
+    def set_on_breach(self, fn: Optional[Callable[[dict], None]]) -> None:
+        """Attach (or replace) the breach hook after construction — the
+        online pipeline builds its evaluator before any flight recorder
+        exists to capture on it."""
+        self._on_breach = fn
+
+    def burn_rates(self) -> Dict[str, float]:
+        """Per-SLO fast-window burn rates right now — the signal surface
+        the serving policy engine reads when it wants to attribute a
+        scale decision to one SLO rather than the fleet-wide max."""
+        with self._lock:
+            return {
+                name: row.get("fast_burn", 0.0)
+                for name, row in sorted(self._last.items())
+            }
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -144,6 +144,7 @@ class Master:
         self.recovery_clock = None
         self.policy_engine = None
         self.serving_fleet = None
+        self.serving_policy = None
         self.freshness = None
         self.metric_history = None
         self.slo_evaluator = None
@@ -271,6 +272,27 @@ class Master:
                 specs=shipped_specs(args),
                 interval_s=slo_interval,
                 on_breach=self.flight_recorder.breach,
+            )
+        # Serving autoscaler (docs/SERVING.md "Autoscaling &
+        # backpressure"): needs the fleet to actuate and an explicit
+        # --max_serving_replicas opt-in.  Burn-rate and shed-ratio
+        # signals degrade to 0 gracefully when the history/SLO loops
+        # are not configured — the engine then only ever scales down on
+        # batch fill, which is the safe direction.
+        if (
+            self.serving_fleet is not None
+            and getattr(args, "max_serving_replicas", 0) > 0
+        ):
+            from elasticdl_tpu.master.policy import (
+                ServingPolicyConfig,
+                ServingPolicyEngine,
+            )
+
+            self.serving_policy = ServingPolicyEngine(
+                self.serving_fleet,
+                ServingPolicyConfig.from_args(args),
+                history=self.metric_history,
+                evaluator=self.slo_evaluator,
             )
         self._grpc_server = None
         self._done = threading.Event()
@@ -410,6 +432,14 @@ class Master:
                 "SLO evaluator ticking every %.1fs",
                 self.slo_evaluator.interval_s,
             )
+        if self.serving_policy is not None and self.serving_policy.start():
+            logger.info(
+                "Serving policy engine ticking every %.1fs "
+                "(fleet bounds [%d, %d])",
+                self.serving_policy.config.interval_s,
+                self.serving_policy.config.min_replicas,
+                self.serving_policy.config.max_replicas,
+            )
         # A restored task journal may already be terminal (all shards of
         # the final epoch done): no worker report will ever drain the
         # queue, so give the finish check one proactive run.
@@ -514,6 +544,8 @@ class Master:
             out["policy"] = self.policy_engine.snapshot()
         if self.serving_fleet is not None:
             out["serving_fleet"] = self.serving_fleet.snapshot()
+        if self.serving_policy is not None:
+            out["serving_policy"] = self.serving_policy.snapshot()
         if self.freshness is not None:
             out["freshness"] = self.freshness.snapshot()
         if self.slo_evaluator is not None:
@@ -559,6 +591,8 @@ class Master:
             registries.append(self.policy_engine.metrics_registry)
         if self.serving_fleet is not None:
             registries.append(self.serving_fleet.metrics_registry)
+        if self.serving_policy is not None:
+            registries.append(self.serving_policy.metrics_registry)
         if self.freshness is not None:
             registries.append(self.freshness.metrics_registry)
         if self.slo_evaluator is not None:
@@ -601,6 +635,8 @@ class Master:
             # contribute a coherent Master.snapshot(), then untap
             self.flight_recorder.flush()
             self.flight_recorder.close()
+        if self.serving_policy is not None:
+            self.serving_policy.stop()
         if self.slo_evaluator is not None:
             self.slo_evaluator.stop()
         if self.metric_history is not None:

@@ -480,3 +480,411 @@ class PolicyEngine:
                 "decisions": list(self.decisions),
                 "interval_s": self.config.interval_s,
             }
+
+
+@dataclass
+class ServingPolicyConfig:
+    """Thresholds and bounds for the serving-fleet autoscaler
+    (docs/SERVING.md "Autoscaling & backpressure" maps each field to
+    its --flag)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    interval_s: float = 0.0          # 0 = loop disabled
+    burn_threshold: float = 1.0      # fast SLO burn considered overload
+    shed_threshold: float = 0.02     # windowed shed ratio = overload
+    fill_low: float = 0.2            # mean batch fill considered idle
+    up_ticks: int = 2                # streak gating scale_up entry
+    down_ticks: int = 3              # streak gating scale_down entry
+    scale_step: int = 1              # replicas per action
+    scale_hold_ticks: int = 2        # quiet ticks after any action
+    shed_window_s: float = 30.0      # shed-ratio evidence window
+
+    @classmethod
+    def from_args(cls, args) -> "ServingPolicyConfig":
+        replicas = getattr(args, "serving_replicas", 0)
+        min_replicas = (
+            getattr(args, "min_serving_replicas", 0) or replicas
+        )
+        return cls(
+            min_replicas=max(1, min_replicas),
+            max_replicas=max(
+                getattr(args, "max_serving_replicas", 0), min_replicas, 1
+            ),
+            interval_s=getattr(args, "serving_policy_interval", 0.0),
+            burn_threshold=getattr(
+                args, "serving_burn_threshold", 1.0
+            ),
+            shed_threshold=getattr(
+                args, "serving_shed_threshold", 0.02
+            ),
+            fill_low=getattr(args, "serving_fill_low", 0.2),
+            up_ticks=getattr(args, "serving_up_ticks", 2),
+            down_ticks=getattr(args, "serving_down_ticks", 3),
+            scale_step=getattr(args, "serving_scale_step", 1),
+            scale_hold_ticks=getattr(
+                args, "serving_scale_hold_ticks", 2
+            ),
+            shed_window_s=getattr(args, "serving_shed_window_s", 30.0),
+        )
+
+
+class ServingPolicyEngine:
+    """SLO-driven autoscaler for the serving fleet — the PolicyEngine
+    template applied to the serve tier (docs/SERVING.md "Autoscaling &
+    backpressure").
+
+    Per tick, at most ONE action, chosen from three signals:
+
+    - **SLO burn rate** (`evaluator.max_burn()` over the shipped
+      predict_availability / staleness_p99 SLOs): sustained burn above
+      `burn_threshold` for `up_ticks` consecutive ticks scales up.
+    - **Windowed shed ratio** (`rpc_fleet_sheds_total` over
+      `rpc_fleet_requests_total` deltas from the `MetricHistory` ring,
+      so a past spike ages OUT of the evidence): sustained shedding
+      scales up even before the SLO burns.
+    - **Batch fill** (mean batcher fill across healthy replicas from
+      the fleet manager's probes): a calm, underfilled fleet for
+      `down_ticks` ticks scales down, `prefer="unhealthy"` victims
+      first; a fleet with no offered traffic at all shrinks on reason
+      `idle`.
+
+    Hysteresis mirrors the trainer policy: consecutive-tick streaks
+    gate entry and every action arms `scale_hold_ticks` quiet ticks.
+    Two guards make an action a no-op for the tick WITHOUT resetting
+    streaks, so it retries next tick: the **rolling-reload guard**
+    (never scale while a reload sequence is mid-flight and the
+    projected `model_step` skew of a scale action would break the skew
+    SLO — recorded as `scale_aborted`/`reload_guard`) and the
+    **fleet.scale fault point** (an injected apiserver error aborts the
+    action atomically inside the manager — recorded as
+    `scale_aborted`/`fault`).
+
+    Every decision is a `serving_scale` span event with literal
+    action/reason from the closed SERVING_SCALE_ACTIONS/REASONS
+    vocabularies (graftlint GL-METRIC enforces the literals) plus a
+    clock-free `decisions` record, byte-stable across same-seed runs.
+    """
+
+    def __init__(
+        self,
+        fleet,
+        config: ServingPolicyConfig,
+        history=None,
+        evaluator=None,
+        clock: Callable[[], float] = time.time,
+        shed_series: str = "rpc_fleet_sheds_total",
+        offered_series: str = "rpc_fleet_requests_total",
+    ):
+        self._fleet = fleet
+        self.config = config
+        self._history = history
+        self._evaluator = evaluator
+        self._clock = clock
+        self._shed_series = shed_series
+        self._offered_series = offered_series
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+        self._tick_count = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._hold_ticks = 0
+        self._last_burn = 0.0
+        self._last_shed_ratio = 0.0
+        self._last_fill = 0.0
+        self._last_offered = 0.0
+        self._last_up_reason = "burn_rate"
+        self._last_down_reason = "batch_fill"
+        #: clock-free decision records in tick order (the PolicyEngine
+        #: contract: byte-comparable across same-seed runs).
+        self.decisions: List[dict] = []
+
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self._ticks = self.metrics_registry.counter(
+            "master_serving_policy_ticks_total",
+            "serving policy loop ticks executed",
+        )
+        self._decisions_total = self.metrics_registry.counter(
+            "master_serving_policy_decisions_total",
+            "serving scale actions taken, by action and reason",
+            labelnames=("action", "reason"),
+        )
+        self.metrics_registry.gauge_fn(
+            "master_serving_policy_burn_ratio",
+            lambda: self._last_burn,
+            "max SLO fast-burn multiple at the last tick",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_serving_policy_shed_ratio",
+            lambda: self._last_shed_ratio,
+            "windowed fleet shed ratio at the last tick",
+        )
+        self.metrics_registry.gauge_fn(
+            "master_serving_policy_fill_ratio",
+            lambda: self._last_fill,
+            "mean healthy-replica batch fill at the last tick",
+        )
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> bool:
+        if self.config.interval_s <= 0 or self._thread is not None:
+            return False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="serving-policy", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def stop(self):
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                logger.exception("serving policy tick failed")
+
+    # ---- signals -------------------------------------------------------
+
+    def serving_pressure(self) -> float:
+        """burn rate x shed ratio, from the last tick's signals: the
+        backpressure scalar OnlinePipeline reads to slow its stream
+        poll/arm cadence while serving is overloaded."""
+        with self._lock:
+            return round(self._last_burn * self._last_shed_ratio, 6)
+
+    def _signals_locked(self) -> None:
+        cfg = self.config
+        self._last_burn = 0.0
+        if self._evaluator is not None:
+            try:
+                self._last_burn = float(self._evaluator.max_burn())
+            except Exception:
+                logger.exception("burn-rate probe failed")
+        self._last_shed_ratio = 0.0
+        self._last_offered = 0.0
+        if self._history is not None:
+            try:
+                offered = self._history.counter_delta(
+                    self._offered_series, cfg.shed_window_s
+                )
+                sheds = self._history.counter_delta(
+                    self._shed_series, cfg.shed_window_s
+                )
+                self._last_offered = float(offered or 0.0)
+                if offered:
+                    self._last_shed_ratio = min(
+                        1.0, max(0.0, float(sheds or 0.0) / offered)
+                    )
+            except Exception:
+                logger.exception("shed-ratio probe failed")
+        # Idle-aware minimum, not the mean: one busy replica's full
+        # batches must not mask idle peers (see fleet.fill_signal()).
+        self._last_fill = float(self._fleet.fill_signal())
+
+        if self._last_burn >= cfg.burn_threshold:
+            self._up_streak += 1
+            self._last_up_reason = "burn_rate"
+        elif self._last_shed_ratio >= cfg.shed_threshold:
+            self._up_streak += 1
+            self._last_up_reason = "shed_ratio"
+        else:
+            self._up_streak = 0
+
+        calm = (
+            self._last_burn < cfg.burn_threshold
+            and self._last_shed_ratio < cfg.shed_threshold
+        )
+        if calm and self._last_offered <= 0.0:
+            self._down_streak += 1
+            self._last_down_reason = "idle"
+        elif calm and self._last_fill <= cfg.fill_low:
+            self._down_streak += 1
+            self._last_down_reason = "batch_fill"
+        else:
+            self._down_streak = 0
+
+    # ---- the loop body -------------------------------------------------
+
+    def tick(self) -> Optional[dict]:
+        """One control decision; returns the decision record or None."""
+        with self._lock:
+            return self._tick_locked()
+
+    def _tick_locked(self) -> Optional[dict]:
+        self._tick_count += 1
+        self._ticks.inc()
+        cfg = self.config
+        self._signals_locked()
+        if self._hold_ticks > 0:
+            self._hold_ticks -= 1
+            return None
+        live = self._fleet.live_replicas()
+
+        if self._up_streak >= cfg.up_ticks and live < cfg.max_replicas:
+            step = min(cfg.scale_step, cfg.max_replicas - live)
+            guard = self._reload_guard_locked()
+            if guard is not None:
+                return guard
+            result = self._fleet.scale_up(step)
+            if result is not None and result["action"] == "scale_aborted":
+                # fleet.scale fault: skipped atomically; streaks frozen,
+                # the next tick retries the same action
+                record = self._record(
+                    "scale_aborted", "fault", direction="up",
+                    requested=step,
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_aborted",
+                    reason="fault", tick=self._tick_count,
+                    requested=step,
+                )
+                return record
+            self._hold_ticks = cfg.scale_hold_ticks
+            self._up_streak = 0
+            self._down_streak = 0
+            added = list(result["replicas"]) if result else []
+            if self._last_up_reason == "burn_rate":
+                record = self._record(
+                    "scale_up", "burn_rate",
+                    burn=round(self._last_burn, 3),
+                    shed_ratio=round(self._last_shed_ratio, 4),
+                    replicas=added, target=self._fleet.live_replicas(),
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_up",
+                    reason="burn_rate", tick=self._tick_count,
+                    burn=record["burn"], replicas=added,
+                )
+            else:
+                record = self._record(
+                    "scale_up", "shed_ratio",
+                    shed_ratio=round(self._last_shed_ratio, 4),
+                    burn=round(self._last_burn, 3),
+                    replicas=added, target=self._fleet.live_replicas(),
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_up",
+                    reason="shed_ratio", tick=self._tick_count,
+                    shed_ratio=record["shed_ratio"], replicas=added,
+                )
+            return record
+
+        if (
+            self._down_streak >= cfg.down_ticks
+            and live > cfg.min_replicas
+        ):
+            step = min(cfg.scale_step, live - cfg.min_replicas)
+            guard = self._reload_guard_locked()
+            if guard is not None:
+                return guard
+            result = self._fleet.scale_down(step, prefer="unhealthy")
+            if result is not None and result["action"] == "scale_aborted":
+                record = self._record(
+                    "scale_aborted", "fault", direction="down",
+                    requested=step,
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_aborted",
+                    reason="fault", tick=self._tick_count,
+                    requested=step,
+                )
+                return record
+            self._hold_ticks = cfg.scale_hold_ticks
+            self._up_streak = 0
+            self._down_streak = 0
+            removed = list(result["replicas"]) if result else []
+            if self._last_down_reason == "idle":
+                record = self._record(
+                    "scale_down", "idle",
+                    fill=round(self._last_fill, 3),
+                    replicas=removed,
+                    target=self._fleet.live_replicas(),
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_down",
+                    reason="idle", tick=self._tick_count,
+                    replicas=removed,
+                )
+            else:
+                record = self._record(
+                    "scale_down", "batch_fill",
+                    fill=round(self._last_fill, 3),
+                    replicas=removed,
+                    target=self._fleet.live_replicas(),
+                )
+                events.emit(
+                    events.SERVING_SCALE, action="scale_down",
+                    reason="batch_fill", tick=self._tick_count,
+                    fill=record["fill"], replicas=removed,
+                )
+            return record
+        return None
+
+    def _reload_guard_locked(self) -> Optional[dict]:
+        """The rolling-reload guard: a scale action taken while a reload
+        sequence is mid-flight would place (or retire) replicas at the
+        pending step, and when the projected spread breaks the skew SLO
+        the action is deferred — streaks stay frozen, next tick retries
+        once the roll completes."""
+        slo = getattr(self._fleet.config, "step_skew_slo", 0)
+        if slo <= 0:
+            return None
+        projected = self._fleet.projected_scale_skew()
+        if projected <= slo:
+            return None
+        record = self._record(
+            "scale_aborted", "reload_guard",
+            projected_skew=int(projected), slo=int(slo),
+        )
+        events.emit(
+            events.SERVING_SCALE, action="scale_aborted",
+            reason="reload_guard", tick=self._tick_count,
+            projected_skew=int(projected), slo=int(slo),
+        )
+        return record
+
+    # ---- bookkeeping ---------------------------------------------------
+
+    def _record(self, action: str, reason: str, **inputs) -> dict:
+        assert action in events.SERVING_SCALE_ACTIONS, action
+        assert reason in events.SERVING_SCALE_REASONS, reason
+        self._decisions_total.labels(action=action, reason=reason).inc()
+        record = {"tick": self._tick_count, "action": action,
+                  "reason": reason}
+        record.update(inputs)
+        self.decisions.append(record)
+        logger.info("serving scale decision: %s", record)
+        return record
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            last = self.decisions[-1] if self.decisions else None
+            return {
+                "ticks": self._tick_count,
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+                "hold_ticks": self._hold_ticks,
+                "burn": round(self._last_burn, 3),
+                "shed_ratio": round(self._last_shed_ratio, 4),
+                "fill": round(self._last_fill, 3),
+                "offered_window": round(self._last_offered, 1),
+                "serving_pressure": round(
+                    self._last_burn * self._last_shed_ratio, 6
+                ),
+                "min_replicas": self.config.min_replicas,
+                "max_replicas": self.config.max_replicas,
+                "live_replicas": self._fleet.live_replicas(),
+                "last_decision": dict(last) if last else None,
+                "decisions": list(self.decisions),
+                "interval_s": self.config.interval_s,
+            }

@@ -63,6 +63,7 @@ logger = get_logger(__name__)
 FLEET_ACTIONS = frozenset({
     "relaunch", "relaunch_aborted",
     "reload_step", "reload_refused", "reload_aborted", "reload_failed",
+    "scale_up", "scale_down", "scale_aborted",
 })
 
 #: Pod phases that mean the replica process is gone for good and the
@@ -113,6 +114,11 @@ class _Replica:
         # health RPC's scalar-metric list) — `elasticdl top` columns
         self.queue_wait_p99_s = 0.0
         self.compute_p99_s = 0.0
+        # idle detection: a replica whose `produced_unix_s` stamp did
+        # not advance between probes dispatched nothing in that window,
+        # so its (frozen) fill_ratio no longer describes current load
+        self.produced_unix_s = -1.0
+        self.idle = False
 
 
 class ServingFleetManager:
@@ -168,8 +174,14 @@ class ServingFleetManager:
         self._stop = threading.Event()
 
         self._replicas: Dict[int, _Replica] = {}
+        #: live placement target; `scale_up`/`scale_down` move it between
+        #: the serving policy engine's min/max while `config.replicas`
+        #: stays the initial placement size.
+        self._target = config.replicas
         self._ticks_done = 0
         self._relaunched = 0
+        self._scaled_up = 0
+        self._scaled_down = 0
         self._reloads_done = 0
         self._refused_targets = set()
         self._last_skew = 0
@@ -204,6 +216,18 @@ class ServingFleetManager:
         self._reloads_refused = self.metrics_registry.counter(
             "serving_fleet_reloads_refused_total",
             "rolling reloads refused by the model_step skew SLO",
+        )
+        self._scale_actions = self.metrics_registry.counter(
+            "serving_fleet_scale_actions_total",
+            "fleet scale actions, by direction (aborted = fleet.scale "
+            "fault skipped the action atomically)",
+            labelnames=("direction",),
+        )
+        self.metrics_registry.gauge_fn(
+            "serving_fleet_target_replicas_count",
+            lambda: float(self._target),
+            "live placement target the scale actions move between "
+            "--min_serving_replicas and --max_serving_replicas",
         )
         self.metrics_registry.gauge_fn(
             "serving_fleet_replicas_count",
@@ -364,6 +388,160 @@ class ServingFleetManager:
         )
         return record
 
+    # ---- elastic scaling (docs/SERVING.md "Autoscaling & backpressure")
+
+    def scale_up(self, count: int = 1) -> Optional[dict]:
+        """Place `count` fresh replica slots (new ids above the highest
+        live one, so retired ids are never resurrected into a stale
+        service name).  Fires `fleet.scale` BEFORE any mutation: an
+        injected raise aborts the whole action atomically — nothing
+        placed, nothing counted — and the caller retries next tick."""
+        with self._lock:
+            count = int(count)
+            if count <= 0:
+                return None
+            try:
+                faults.fire(faults.POINT_FLEET_SCALE)
+            except faults.InjectedFault as exc:
+                logger.warning("fleet scale_up aborted: %s", exc)
+                self._scale_actions.labels(direction="aborted").inc()
+                return self._record(
+                    "scale_aborted", direction="up", count=count
+                )
+            added = []
+            for _ in range(count):
+                rid = max(self._replicas) + 1 if self._replicas else 0
+                rep = _Replica(rid)
+                self._replicas[rid] = rep
+                self._launch_locked(rep)
+                added.append(rid)
+            self._target = len(self._replicas)
+            self._scaled_up += len(added)
+            self._scale_actions.labels(direction="up").inc()
+            self._refresh_skew_locked()
+            return self._record(
+                "scale_up", replicas=added, target=self._target
+            )
+
+    def scale_down(self, count: int = 1,
+                   prefer: str = "unhealthy") -> Optional[dict]:
+        """Retire `count` replicas — probe-failing ones first when
+        `prefer="unhealthy"`, then the newest (highest id) healthy ones —
+        through the router (so mid-sweep requests fail over, not fail)
+        and the apiserver.  Refuses to empty the fleet (keeps >= 1).
+        Fires `fleet.scale` before any mutation; an injected raise
+        aborts the whole action atomically."""
+        with self._lock:
+            count = min(int(count), len(self._replicas) - 1)
+            if count <= 0:
+                return None
+            try:
+                faults.fire(faults.POINT_FLEET_SCALE)
+            except faults.InjectedFault as exc:
+                logger.warning("fleet scale_down aborted: %s", exc)
+                self._scale_actions.labels(direction="aborted").inc()
+                return self._record(
+                    "scale_aborted", direction="down", count=count
+                )
+            if prefer == "unhealthy":
+                unhealthy = sorted(
+                    rid for rid, rep in self._replicas.items()
+                    if not rep.healthy
+                )
+                healthy = sorted(
+                    (rid for rid, rep in self._replicas.items()
+                     if rep.healthy),
+                    reverse=True,
+                )
+                victims = (unhealthy + healthy)[:count]
+            else:
+                victims = sorted(self._replicas, reverse=True)[:count]
+            for rid in victims:
+                rep = self._replicas.pop(rid)
+                if self._router is not None:
+                    self._router.remove_client(rid)
+                if rep.pod_name:
+                    try:
+                        self._k8s.delete_pod(rep.pod_name)
+                    except Exception:
+                        logger.warning(
+                            "retired replica %d pod delete failed "
+                            "(continuing)", rid,
+                        )
+            self._target = len(self._replicas)
+            self._scaled_down += len(victims)
+            self._scale_actions.labels(direction="down").inc()
+            self._refresh_skew_locked()
+            return self._record(
+                "scale_down", replicas=victims, target=self._target
+            )
+
+    def live_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def healthy_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.healthy)
+
+    def mean_fill_ratio(self) -> float:
+        """Mean batcher fill across healthy replicas (last probe) — the
+        serving policy engine's batch-fill signal."""
+        with self._lock:
+            fills = [
+                rep.fill_ratio for rep in self._replicas.values()
+                if rep.healthy
+            ]
+            return sum(fills) / len(fills) if fills else 0.0
+
+    def fill_signal(self) -> float:
+        """Effective batch-fill for the serving policy engine's
+        scale-down path: the MINIMUM across healthy replicas, counting a
+        replica that produced nothing since its previous probe as 0.0.
+        The mean hides over-provisioning — a busy replica's full batches
+        mask three idle peers whose last-reported fill is frozen at its
+        spike-era value — while a zero minimum is direct evidence the
+        fleet holds capacity the traffic provably is not using."""
+        with self._lock:
+            fills = [
+                0.0 if rep.idle else rep.fill_ratio
+                for rep in self._replicas.values()
+                if rep.healthy
+            ]
+            return min(fills) if fills else 0.0
+
+    def _reload_gap_locked(self) -> int:
+        """Steps the furthest-behind healthy replica still trails the
+        newest pending checkpoint — > 0 means a rolling-reload sequence
+        is mid-flight (a freshly scaled replica would boot at the
+        pending step, making this gap the projected scale skew)."""
+        if self._pending_step_fn is None:
+            return 0
+        try:
+            target = self._pending_step_fn()
+        except Exception:
+            return 0
+        if target is None or target in self._refused_targets:
+            return 0
+        steps = [
+            rep.model_step for rep in self._replicas.values()
+            if rep.healthy
+        ]
+        if not steps:
+            return 0
+        return max(0, int(target) - min(steps))
+
+    def projected_scale_skew(self) -> int:
+        """The `model_step` spread a scale action taken NOW could create:
+        the reload-guard signal the serving policy engine checks against
+        the skew SLO before acting (0 when no reload is in flight)."""
+        with self._lock:
+            return self._reload_gap_locked()
+
+    def reload_in_progress(self) -> bool:
+        with self._lock:
+            return self._reload_gap_locked() > 0
+
     # ---- the loop body -------------------------------------------------
 
     def tick(self) -> List[dict]:
@@ -432,6 +610,10 @@ class ServingFleetManager:
             health_metrics.get("phase_compute_p99_s", 0.0)
         )
         produced = health_metrics.get("produced_unix_s")
+        if produced is not None:
+            stamp = float(produced)
+            rep.idle = stamp <= rep.produced_unix_s
+            rep.produced_unix_s = stamp
         if self._router is not None:
             self._router.mark_live(rep.replica_id)
             self._router.observe_health(
@@ -567,6 +749,9 @@ class ServingFleetManager:
                 },
                 "ticks": self._ticks_done,
                 "relaunches": self._relaunched,
+                "target_replicas": self._target,
+                "scale_ups": self._scaled_up,
+                "scale_downs": self._scaled_down,
                 "reload_steps": self._reloads_done,
                 "model_step_skew": self._last_skew,
                 "max_model_step_skew": self._max_skew,

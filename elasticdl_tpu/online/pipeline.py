@@ -51,19 +51,30 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.history import MetricHistory
 from elasticdl_tpu.common.k8s_client import FakeK8sClient
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.resilience import RetryPolicy
 from elasticdl_tpu.common.save_utils import CheckpointSaver
-from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
+from elasticdl_tpu.common.slo import (
+    SLO_PREDICT_SHED_RATIO,
+    SLO_STALENESS_P99,
+    SloEvaluator,
+    shipped_specs,
+)
 from elasticdl_tpu.data.reader.stream_reader import (
     ClickStreamSource,
     StreamReader,
 )
 from elasticdl_tpu.master.freshness import FreshnessTracker
-from elasticdl_tpu.master.policy import PolicyConfig, PolicyEngine
+from elasticdl_tpu.master.policy import (
+    PolicyConfig,
+    PolicyEngine,
+    ServingPolicyConfig,
+    ServingPolicyEngine,
+)
 from elasticdl_tpu.master.serving_fleet import (
     ServingFleetConfig,
     ServingFleetManager,
@@ -100,6 +111,17 @@ class OnlineConfig:
     max_workers: int = 0             # > workers enables the PolicyEngine
     stream_lag_s: float = 60.0       # scale-up threshold (watermark lag)
     stream_lag_ticks: int = 2
+    # ---- serving autoscaler + train/serve backpressure ----
+    max_serving_replicas: int = 0    # > replicas enables the autoscaler
+    min_serving_replicas: int = 0    # 0 = `replicas` (the placed size)
+    serving_up_ticks: int = 2        # autoscaler hysteresis streaks
+    serving_down_ticks: int = 3
+    serving_scale_hold_ticks: int = 2
+    serving_shed_window_s: float = 30.0
+    serving_burn_threshold: float = 1.0
+    serving_shed_threshold: float = 0.02
+    backpressure_threshold: float = 0.25  # serving_pressure gate
+    backpressure_stride: int = 4     # poll/arm every Nth tick when over
 
 
 class _KillableClient:
@@ -219,7 +241,12 @@ class OnlinePipeline:
         config: Optional[OnlineConfig] = None,
         clock: Callable[[], float] = time.time,
         source=None,
+        client_wrapper: Optional[Callable] = None,
     ):
+        # `client_wrapper(rid, client) -> client` interposes on every
+        # replica client the router sees (including ones the autoscaler
+        # launches later) — how bench.py --traffic models a replica's
+        # finite per-tick serving capacity without faking the servicer.
         import jax
 
         from elasticdl_tpu.serving.batcher import DynamicBatcher
@@ -335,27 +362,39 @@ class OnlinePipeline:
             freshness=self.freshness,
         )
         self._fleet = {}
+
+        def make_replica(rid):
+            # Lazily materialised so the autoscaler's scale_up can mint
+            # replicas past the initial placement — a scaled-in replica
+            # that returns later reuses its warmed engine.
+            if rid not in self._fleet:
+                engine = ServingEngine.from_checkpoint(
+                    checkpoint_dir, spec, self._sample, buckets=(2, 8)
+                )
+                batcher = DynamicBatcher(engine, max_latency_s=0.002)
+                reloader = CheckpointReloader(
+                    engine, checkpoint_dir, poll_interval_s=3600.0
+                )
+                self._fleet[rid] = {
+                    "engine": engine,
+                    "batcher": batcher,
+                    "reloader": reloader,
+                    "servicer": ServingServicer(engine, batcher, reloader),
+                    "client": None,
+                }
+            return self._fleet[rid]
+
         for rid in range(cfg.replicas):
-            engine = ServingEngine.from_checkpoint(
-                checkpoint_dir, spec, self._sample, buckets=(2, 8)
-            )
-            batcher = DynamicBatcher(engine, max_latency_s=0.002)
-            reloader = CheckpointReloader(
-                engine, checkpoint_dir, poll_interval_s=3600.0
-            )
-            self._fleet[rid] = {
-                "engine": engine,
-                "batcher": batcher,
-                "reloader": reloader,
-                "servicer": ServingServicer(engine, batcher, reloader),
-                "client": None,
-            }
+            make_replica(rid)
 
         def client_factory(rid, _addr):
-            self._fleet[rid]["client"] = _KillableClient(
-                self._fleet[rid]["servicer"]
-            )
-            return self._fleet[rid]["client"]
+            rep = make_replica(rid)
+            # kill_replica flips the INNER client's switch, so a wrapped
+            # client still dies when chaos asks it to
+            rep["client"] = _KillableClient(rep["servicer"])
+            if client_wrapper is not None:
+                return client_wrapper(rid, rep["client"])
+            return rep["client"]
 
         self.fleet_manager = ServingFleetManager(
             self.k8s,
@@ -381,8 +420,12 @@ class OnlinePipeline:
         # The history samples the stream-lag gauges alongside the
         # freshness/fleet series, so `elasticdl slo` history coverage
         # includes the stream-lag series (docs/OBSERVABILITY.md).
+        # The process-wide default registry carries the router's
+        # rpc_fleet_requests/sheds counters — the windowed shed-ratio
+        # evidence the serving autoscaler reads.
         self.history = MetricHistory(
             registries=[
+                metrics_lib.default_registry(),
                 self.freshness.metrics_registry,
                 self.fleet_manager.metrics_registry,
                 self.reader.metrics_registry,
@@ -391,11 +434,59 @@ class OnlinePipeline:
             ],
             clock=clock,
         )
+        # Staleness (the train->serve freshness promise) plus the
+        # shed-ratio SLO whose burn is the autoscaler's and the
+        # backpressure signal's overload evidence.
         self.evaluator = SloEvaluator(
-            self.history, specs=[shipped_specs()[0]], clock=clock,
+            self.history,
+            specs=[
+                s for s in shipped_specs()
+                if s.name in (SLO_STALENESS_P99, SLO_PREDICT_SHED_RATIO)
+            ],
+            clock=clock,
         )
         self.max_burn = 0.0
         self.ticks = 0
+
+        # ---- serving autoscaler + backpressure --------------------------
+        self.serving_policy: Optional[ServingPolicyEngine] = None
+        if cfg.max_serving_replicas > cfg.replicas:
+            self.serving_policy = ServingPolicyEngine(
+                self.fleet_manager,
+                ServingPolicyConfig(
+                    min_replicas=cfg.min_serving_replicas or cfg.replicas,
+                    max_replicas=cfg.max_serving_replicas,
+                    up_ticks=cfg.serving_up_ticks,
+                    down_ticks=cfg.serving_down_ticks,
+                    scale_hold_ticks=cfg.serving_scale_hold_ticks,
+                    shed_window_s=cfg.serving_shed_window_s,
+                    burn_threshold=cfg.serving_burn_threshold,
+                    shed_threshold=cfg.serving_shed_threshold,
+                ),
+                history=self.history,
+                evaluator=self.evaluator,
+                clock=clock,
+            )
+        # serving_pressure = burn rate x shed ratio, refreshed each tick
+        # from the router's own request/shed counters: when serving is
+        # overloaded, training slows its ingest instead of racing the
+        # serve tier for the machine (docs/SERVING.md "Autoscaling &
+        # backpressure").
+        self._serving_pressure = 0.0
+        self._polls_skipped = 0
+        self._router_seen = {"requests": 0, "sheds": 0}
+        self.metrics_registry = metrics_lib.MetricsRegistry()
+        self.metrics_registry.gauge_fn(
+            "master_serving_pressure_ratio",
+            lambda: self._serving_pressure,
+            "burn rate x fleet shed ratio at the last tick — the "
+            "train-side backpressure signal",
+        )
+        self._backpressure_skips = self.metrics_registry.counter(
+            "master_backpressure_skipped_polls_total",
+            "stream poll/arm rounds skipped because serving pressure "
+            "was over --backpressure_threshold",
+        )
 
     # ---- one loop iteration ---------------------------------------------
 
@@ -408,9 +499,25 @@ class OnlinePipeline:
         `max_train_tasks` caps this tick's training (a slow trainer
         fleet in miniature): leftover tasks stay queued, which is what
         lets chaos land a master restart while windows are mid-flight
-        and lets backlog build for the policy signals."""
-        polled = self.reader.poll()
-        self._arm_pending()
+        and lets backlog build for the policy signals.
+
+        Backpressure: while last tick's `serving_pressure` (burn rate x
+        fleet shed ratio) is over `backpressure_threshold`, the stream
+        poll/arm pair runs only every `backpressure_stride`-th tick —
+        ingest slows, already-queued tasks still drain, and the serve
+        tier gets the machine back until the pressure clears."""
+        cfg = self.config
+        backpressured = (
+            self._serving_pressure > cfg.backpressure_threshold
+            and self.ticks % max(1, cfg.backpressure_stride) != 0
+        )
+        if backpressured:
+            polled = 0
+            self._polls_skipped += 1
+            self._backpressure_skips.inc()
+        else:
+            polled = self.reader.poll()
+            self._arm_pending()
         if self.policy is not None:
             self.policy.tick()
         trained = self._drain_tasks(max_train_tasks)
@@ -418,6 +525,9 @@ class OnlinePipeline:
         self.fleet_manager.tick()
         self.history.tick()
         self.evaluator.tick()
+        if self.serving_policy is not None:
+            self.serving_policy.tick()
+        self._refresh_pressure()
         self.max_burn = max(self.max_burn, self.evaluator.max_burn())
         self.ticks += 1
         return {
@@ -426,7 +536,22 @@ class OnlinePipeline:
             "checkpointed": saved,
             "model_step": int(self.state.step),
             "loss": self._last_loss,
+            "backpressured": backpressured,
         }
+
+    def _refresh_pressure(self) -> None:
+        """Recompute `serving_pressure` from this tick's router deltas
+        (clock-free: instance counters, not wall-clock windows)."""
+        stats = self.router.stats()
+        requests = int(stats.get("requests", 0))
+        sheds = int(stats.get("sheds", 0))
+        d_requests = requests - self._router_seen["requests"]
+        d_sheds = sheds - self._router_seen["sheds"]
+        self._router_seen = {"requests": requests, "sheds": sheds}
+        shed_ratio = d_sheds / d_requests if d_requests > 0 else 0.0
+        self._serving_pressure = round(
+            self.evaluator.max_burn() * shed_ratio, 6
+        )
 
     def _arm_pending(self) -> None:
         self._pending_windows.extend(self.reader.take_new_windows())
@@ -752,6 +877,16 @@ class OnlinePipeline:
             "policy": (
                 self.policy.snapshot() if self.policy is not None else None
             ),
+            "serving_policy": (
+                self.serving_policy.snapshot()
+                if self.serving_policy is not None else None
+            ),
+            "backpressure": {
+                "serving_pressure": self._serving_pressure,
+                "polls_skipped": self._polls_skipped,
+                "threshold": self.config.backpressure_threshold,
+                "stride": self.config.backpressure_stride,
+            },
             "windows_trained": self._windows_trained,
             "examples_trained": self._examples_trained,
             "model_step": int(self.state.step),

@@ -275,6 +275,12 @@ _fleet_request_errors_counter = _metrics.default_registry().counter(
     "Predict requests that failed after every replica and retry was "
     "exhausted — the bad events of the predict_availability SLO",
 )
+_fleet_sheds_counter = _metrics.default_registry().counter(
+    "rpc_fleet_sheds_total",
+    "requests the whole fleet shed (admission control answered for "
+    "every replica) — with rpc_fleet_requests_total, the windowed shed "
+    "ratio the serving policy engine and the backpressure signal read",
+)
 _fleet_route_histogram = _metrics.default_registry().histogram(
     "rpc_fleet_route_seconds",
     "router-side end-to-end Predict latency (the `route` phase of the "
@@ -337,6 +343,8 @@ class FleetRouter:
         self._rr = 0
         self._max_skew = 0
         self._failovers = {"error": 0, "overloaded": 0, "shutdown": 0}
+        self._requests = 0
+        self._sheds = 0
         self._last_staleness = (0, 0.0)
         # Trace context (docs/OBSERVABILITY.md "Request tracing"): ids
         # come off a monotonic per-router counter — deterministic under
@@ -378,7 +386,10 @@ class FleetRouter:
     def mark_live(self, replica_id) -> None:
         with self._lock:
             self._down.discard(replica_id)
-            self._penalty[replica_id] = 0
+            if replica_id in self._clients:
+                # a probe racing remove_client must not resurrect a
+                # penalty bucket for a retired replica
+                self._penalty[replica_id] = 0
 
     def observe_health(self, replica_id, fill_ratio=0.0, queue_depth=0,
                        model_step=None, produced_unix_s=None) -> None:
@@ -425,6 +436,8 @@ class FleetRouter:
             return {
                 "replicas": len(self._clients),
                 "down": sorted(self._down),
+                "requests": self._requests,
+                "sheds": self._sheds,
                 "failovers": dict(self._failovers),
                 "max_model_step_skew": self._max_skew,
                 "last_staleness_steps": self._last_staleness[0],
@@ -474,7 +487,10 @@ class FleetRouter:
             except Exception as exc:  # transport/injected: demote, move on
                 last_error = exc
                 with self._lock:
-                    self._penalty[rid] = self._penalty.get(rid, 0) + 1
+                    # a replica retired while its call was in flight
+                    # must not get a resurrected penalty bucket
+                    if rid in self._clients:
+                        self._penalty[rid] = self._penalty.get(rid, 0) + 1
                     self._failovers["error"] += 1
                 _fleet_failovers_counter.labels(reason="error").inc()
                 continue
@@ -485,14 +501,16 @@ class FleetRouter:
                     else "shutdown"
                 )
                 with self._lock:
-                    self._penalty[rid] = self._penalty.get(rid, 0) + 1
+                    if rid in self._clients:
+                        self._penalty[rid] = self._penalty.get(rid, 0) + 1
                     self._failovers[reason] += 1
                 _fleet_failovers_counter.labels(reason=reason).inc()
                 shed_response = response
                 continue
             with self._lock:
-                self._penalty[rid] = 0
-                self._note_step_locked(rid, int(response.model_step))
+                if rid in self._clients:
+                    self._penalty[rid] = 0
+                    self._note_step_locked(rid, int(response.model_step))
             if self._freshness is not None:
                 steps, seconds = self._freshness.observe_response(
                     int(response.model_step)
@@ -502,6 +520,13 @@ class FleetRouter:
             return response
         if shed_response is not None:
             return shed_response
+        if last_error is None:
+            # Every candidate was retired mid-sweep (scale_down racing
+            # this request): retryable, the next sweep sees the new
+            # membership — never `raise None`.
+            raise ConnectionError(
+                "no serving replica survived the sweep"
+            )
         raise last_error
 
     def predict(self, request, timeout=None):
@@ -516,6 +541,7 @@ class FleetRouter:
         _fleet_requests_counter.inc()
         with self._lock:
             self._seq += 1
+            self._requests += 1
             seq = self._seq
             failovers_before = sum(self._failovers.values())
         sampled = self._trace_every > 0 and seq % self._trace_every == 0
@@ -548,6 +574,9 @@ class FleetRouter:
             failed_over = sum(self._failovers.values()) > failovers_before
         phases = {"route": route_s}
         if response.code in SHED_CODES:
+            _fleet_sheds_counter.inc()
+            with self._lock:
+                self._sheds += 1
             # whole-fleet shed: admission control spoke — always capture
             events.emit(
                 events.PREDICT_SPAN, request_id=request_id,

@@ -1,0 +1,15 @@
+"""Replayable open-loop traffic for the serving control loop.
+
+`generator.py` turns a seed plus a profile name from the closed
+TRAFFIC_PROFILES vocabulary into a byte-identical request schedule and
+drives the fleet router with it — the load side of the autoscaling
+story in docs/SERVING.md "Autoscaling & backpressure".
+"""
+
+from elasticdl_tpu.traffic.generator import (  # noqa: F401
+    REQUEST_SHAPES,
+    TRAFFIC_PROFILES,
+    TrafficConfig,
+    TrafficGenerator,
+    router_request_fn,
+)

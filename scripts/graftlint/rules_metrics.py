@@ -28,7 +28,11 @@ patterns over elasticdl_tpu/:
 
 4. **Policy-decision fields.**  Every `emit(events.POLICY_DECISION,
    ...)` must carry `action=`/`reason=` string literals drawn from the
-   closed POLICY_ACTIONS / POLICY_REASONS vocabularies.
+   closed POLICY_ACTIONS / POLICY_REASONS vocabularies.  The same
+   contract covers `emit(events.SERVING_SCALE, ...)` against
+   SERVING_SCALE_ACTIONS / SERVING_SCALE_REASONS — the serving
+   autoscaler's decisions are dashboards' evidence exactly like the
+   trainer policy's.
 
 5. **Request-span fields.**  Every `emit(events.PREDICT_SPAN, ...)`
    must carry a `request_id=` kwarg (a span an operator cannot
@@ -60,6 +64,8 @@ if REPO not in sys.path:  # the shared validators live in the runtime
 from elasticdl_tpu.common.events import (  # noqa: E402
     POLICY_ACTIONS,
     POLICY_REASONS,
+    SERVING_SCALE_ACTIONS,
+    SERVING_SCALE_REASONS,
     SPAN_PHASES,
     SPAN_REASONS,
 )
@@ -207,6 +213,52 @@ def find_unlabeled_policy_decisions(tree: ast.AST):
                 )
 
 
+def find_unlabeled_serving_scales(tree: ast.AST):
+    """Yield (lineno, message) for `emit(events.SERVING_SCALE, ...)`
+    calls missing `action=`/`reason=` string literals from the closed
+    SERVING_SCALE_ACTIONS / SERVING_SCALE_REASONS vocabularies in
+    common/events.py — the serving-autoscaler mirror of
+    find_unlabeled_policy_decisions."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Attribute)
+                and first.attr == "SERVING_SCALE"):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        for field, vocab in (
+            ("action", SERVING_SCALE_ACTIONS),
+            ("reason", SERVING_SCALE_REASONS),
+        ):
+            value = kwargs.get(field)
+            if value is None:
+                yield (
+                    node.lineno,
+                    "emit(events.SERVING_SCALE, ...) must carry "
+                    f"{field}= — a scale decision without it cannot "
+                    "be grepped off the event stream",
+                )
+            elif not (isinstance(value, ast.Constant)
+                      and isinstance(value.value, str)):
+                yield (
+                    node.lineno,
+                    f"emit(events.SERVING_SCALE, ...): {field}= must "
+                    "be a string literal from the closed vocabulary in "
+                    "common/events.py, not a computed value",
+                )
+            elif value.value not in vocab:
+                yield (
+                    node.lineno,
+                    f"emit(events.SERVING_SCALE, ...): "
+                    f"{field}={value.value!r} is not in the closed "
+                    f"vocabulary {sorted(vocab)}",
+                )
+
+
 def find_untraced_predict_spans(tree: ast.AST):
     """Yield (lineno, message) for `emit(events.PREDICT_SPAN, ...)`
     calls missing `request_id=`, or whose `reason=`/`phase=` fields are
@@ -326,6 +378,8 @@ class MetricRule(Rule):
             for lineno, message in find_stringly_events(pf.tree):
                 yield Finding(pf.rel, lineno, self.id, message)
         for lineno, message in find_unlabeled_policy_decisions(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+        for lineno, message in find_unlabeled_serving_scales(pf.tree):
             yield Finding(pf.rel, lineno, self.id, message)
         for lineno, message in find_untraced_predict_spans(pf.tree):
             yield Finding(pf.rel, lineno, self.id, message)

@@ -1,0 +1,132 @@
+"""FleetRouter membership churn under live traffic: replicas retiring
+mid-retry-sweep surface as retryable routing (never a TypeError from
+`raise None`, never a client error when a survivor exists), replicas
+joining during a whole-fleet shed take traffic immediately with a clean
+penalty slate, and a probe racing `remove_client` cannot resurrect a
+retired replica's penalty bucket."""
+
+import pytest
+
+from elasticdl_tpu.common.resilience import (
+    RetryBudgetExhausted,
+    RetryPolicy,
+)
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.proto.service import FleetRouter
+
+
+def _policy(max_attempts=4):
+    return RetryPolicy(
+        initial_backoff_s=0.0, max_backoff_s=0.0, max_elapsed_s=30.0,
+        max_attempts=max_attempts, sleep=lambda _s: None,
+    )
+
+
+class StubClient:
+    """Scripted replica: mode decides the response; `on_predict` lets a
+    test retire replicas from *inside* a sweep, the way a concurrent
+    scale_down interleaves with routing."""
+
+    def __init__(self, mode="ok", on_predict=None):
+        self.mode = mode
+        self.on_predict = on_predict
+        self.calls = 0
+
+    def predict(self, request, timeout=None):
+        self.calls += 1
+        if self.on_predict is not None:
+            self.on_predict()
+        if self.mode == "raise":
+            raise ConnectionError("replica gone")
+        response = spb.PredictResponse()
+        response.code = (
+            spb.SERVING_OVERLOADED if self.mode == "shed"
+            else spb.SERVING_OK
+        )
+        response.model_step = 7
+        return response
+
+    def health(self, request, timeout=None):
+        return self.predict(request, timeout=timeout)
+
+
+def _request():
+    return spb.PredictRequest()
+
+
+def test_all_candidates_retired_mid_sweep_is_retryable():
+    """Every ranked candidate vanished between ranking and dispatch
+    (scale_down racing the sweep): the sweep must raise a retryable
+    ConnectionError — not TypeError from `raise None` — and the retry
+    must succeed once membership settles."""
+    router = FleetRouter(clients={0: StubClient()},
+                         retry_policy=_policy())
+    ranked = router._ranked
+    orders = [[9], [8, 7]]                  # two sweeps of retired ids
+
+    def racing_ranked():
+        return orders.pop(0) if orders else ranked()
+
+    router._ranked = racing_ranked
+    response = router.predict(_request())
+    assert response.code == spb.SERVING_OK  # third sweep found replica 0
+
+    router._ranked = lambda: [9]            # membership never settles
+    with pytest.raises(RetryBudgetExhausted,
+                       match="no serving replica survived"):
+        router.predict(_request())
+
+
+def test_replica_retired_mid_sweep_fails_over_to_survivor():
+    """Replica 0 dies AND is retired while its predict is in flight;
+    the same sweep moves on and the survivor answers — no failed
+    request, and the retired id leaves no penalty bucket behind."""
+    router = FleetRouter(retry_policy=_policy())
+    survivor = StubClient()
+
+    def retire_self():
+        router.remove_client(0)
+        raise ConnectionError("retired mid-flight")
+
+    router.set_client(0, StubClient(on_predict=retire_self))
+    router.set_client(1, survivor)
+    response = router.predict(_request())
+    assert response.code == spb.SERVING_OK
+    assert survivor.calls == 1
+    assert 0 not in router._penalty
+    assert router.replica_ids() == [1]
+
+
+def test_join_during_whole_fleet_shed_takes_traffic_clean():
+    """A fleet of one shedding replica returns the shed in-band (no
+    retry storm, no exception).  A replica joining right then gets a
+    zero penalty bucket and takes the next request immediately."""
+    shedder = StubClient(mode="shed")
+    router = FleetRouter(clients={0: shedder}, retry_policy=_policy())
+    response = router.predict(_request())
+    assert response.code == spb.SERVING_OVERLOADED  # shed, not raise
+    assert router._penalty[0] >= 1
+
+    joiner = StubClient()
+    router.set_client(1, joiner)
+    assert router._penalty[1] == 0          # clean slate on join
+    response = router.predict(_request())
+    assert response.code == spb.SERVING_OK
+    assert joiner.calls == 1
+    stats = router.stats()
+    assert stats["requests"] == 2
+    assert stats["failovers"]["overloaded"] >= 1
+
+
+def test_mark_live_cannot_resurrect_a_retired_penalty_bucket():
+    router = FleetRouter(clients={0: StubClient(), 1: StubClient()},
+                         retry_policy=_policy())
+    router.mark_down(0)
+    router.remove_client(0)
+    router.mark_live(0)                     # the racing probe result
+    assert 0 not in router._penalty
+    assert 0 not in router._fill
+    assert router.replica_ids() == [1]
+    # re-admission goes through set_client and starts clean
+    router.set_client(0, StubClient())
+    assert router._penalty[0] == 0

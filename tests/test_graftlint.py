@@ -684,3 +684,58 @@ def test_whole_repo_is_clean():
     assert proc.returncode == 0, (
         f"graftlint findings:\n{proc.stdout}{proc.stderr}"
     )
+
+
+def test_serving_scale_literal_vocab_clean():
+    src = (
+        "from elasticdl_tpu.common import events\n"
+        "events.emit(events.SERVING_SCALE, action='scale_up',\n"
+        "            reason='burn_rate', tick=3)\n"
+    )
+    assert not check_source(src, "elasticdl_tpu/master/x.py",
+                            [rules_metrics.MetricRule()])
+
+
+def test_serving_scale_missing_field_positive():
+    src = (
+        "from elasticdl_tpu.common import events\n"
+        "events.emit(events.SERVING_SCALE, action='scale_up', tick=3)\n"
+    )
+    found = check_source(src, "elasticdl_tpu/master/x.py",
+                         [rules_metrics.MetricRule()])
+    assert _ids(found) == ["GL-METRIC"]
+    assert "must carry reason=" in found[0].message
+
+
+def test_serving_scale_computed_value_positive():
+    src = (
+        "from elasticdl_tpu.common import events\n"
+        "events.emit(events.SERVING_SCALE, action=chosen,\n"
+        "            reason='burn_rate')\n"
+    )
+    found = check_source(src, "elasticdl_tpu/master/x.py",
+                         [rules_metrics.MetricRule()])
+    assert _ids(found) == ["GL-METRIC"]
+    assert "string literal" in found[0].message
+
+
+def test_serving_scale_out_of_vocabulary_positive():
+    src = (
+        "from elasticdl_tpu.common import events\n"
+        "events.emit(events.SERVING_SCALE, action='scale_up',\n"
+        "            reason='vibes')\n"
+    )
+    found = check_source(src, "elasticdl_tpu/master/x.py",
+                         [rules_metrics.MetricRule()])
+    assert _ids(found) == ["GL-METRIC"]
+    assert "not in the closed vocabulary" in found[0].message
+
+
+def test_serving_scale_suppressed():
+    src = (
+        "from elasticdl_tpu.common import events\n"
+        "events.emit(events.SERVING_SCALE, action='scale_up')"
+        "  # graftlint: disable=GL-METRIC\n"
+    )
+    assert not check_source(src, "elasticdl_tpu/master/x.py",
+                            [rules_metrics.MetricRule()])

@@ -213,6 +213,31 @@ def test_top_renders_online_line(loop_result):
     assert "online:" not in batch
 
 
+def test_top_renders_traffic_line():
+    frame = top_render({
+        "metrics": {"traffic_offered_per_sec": 12.5},
+        "snapshot": {
+            "tasks": {},
+            "serving_policy": {
+                "shed_ratio": 0.081, "burn": 2.5, "live_replicas": 3,
+                "min_replicas": 1, "max_replicas": 4, "hold_ticks": 2,
+                "last_decision": {
+                    "action": "scale_up", "reason": "shed_ratio",
+                    "tick": 9,
+                },
+            },
+        },
+    })
+    (line,) = [l for l in frame.splitlines() if l.startswith("traffic:")]
+    assert "offered=12.5/s" in line
+    assert "shed_ratio=0.081" in line
+    assert "burn=2.50x" in line
+    assert "fleet=3[1-4]" in line
+    assert "last=scale_up/shed_ratio@t9" in line
+    # a master without the policy engine renders no traffic line
+    assert "traffic:" not in top_render({"snapshot": {"tasks": {}}})
+
+
 def test_slo_report_covers_stream_lag(loop_result):
     report = render_slo(loop_result["snap"]["slo"])
     assert "stream lag:" in report
@@ -242,3 +267,55 @@ def test_online_summary_matches_script():
     assert summary["windows_armed"] >= summary["windows_trained"]
     assert summary["windows_lost"] == 0
     assert summary["handoffs"] == 0  # single-worker smoke: no handoffs
+
+
+def test_backpressure_slows_poll_cadence_and_recovers(spec, tmp_path):
+    """docs/SERVING.md "Autoscaling & backpressure": while
+    serving_pressure is over the threshold the stream poll/arm pair
+    runs only every `backpressure_stride`-th tick (queued tasks still
+    drain), and the cadence snaps back the tick pressure clears."""
+    clk = [3_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    cfg = OnlineConfig(
+        seed=11, window_records=64, records_per_poll=64,
+        records_per_task=16, checkpoint_every_windows=4, replicas=1,
+        backpressure_threshold=0.25, backpressure_stride=4,
+    )
+    pipe = OnlinePipeline(str(tmp_path), spec, cfg, clock=clock)
+    try:
+        # tick 0 polls and arms one 64-record window -> 4 queued tasks
+        first = pipe.tick(max_train_tasks=1)
+        assert first["polled"] > 0 and not first["backpressured"]
+
+        # pin the pressure over the threshold: the per-tick refresh
+        # would zero it again (no sheds in this driver), so freeze it
+        # the way a sustained overload would hold it up
+        pipe._serving_pressure = 1.0
+        refresh, pipe._refresh_pressure = pipe._refresh_pressure, lambda: None
+        results = [pipe.tick(max_train_tasks=1) for _ in range(3)]
+        # ticks 1..3 are off-stride: every poll is skipped...
+        assert all(r["backpressured"] and r["polled"] == 0 for r in results)
+        # ...but the already-queued tasks keep draining
+        assert sum(r["trained_tasks"] for r in results) == 3
+        # tick 4 is the stride tick: ingest resumes even under pressure
+        stride_tick = pipe.tick(max_train_tasks=1)
+        assert not stride_tick["backpressured"]
+
+        snap = pipe.snapshot()
+        assert snap["backpressure"]["polls_skipped"] == 3
+        assert snap["backpressure"]["serving_pressure"] == 1.0
+        assert snap["backpressure"]["threshold"] == 0.25
+        assert snap["backpressure"]["stride"] == 4
+
+        # pressure clears -> off-stride ticks poll again immediately
+        pipe._refresh_pressure = refresh
+        pipe._serving_pressure = 0.0
+        recovered = pipe.tick(max_train_tasks=1)
+        assert not recovered["backpressured"]
+        assert pipe.snapshot()["backpressure"]["polls_skipped"] == 3
+    finally:
+        pipe.shutdown()

@@ -694,7 +694,7 @@ def test_elasticdl_slo_reports_live_fleet(tmp_path, capsys):
     from elasticdl_tpu.common.telemetry import TelemetryServer
 
     f = _Fleet(tmp_path, skew_slo=10, with_freshness=True)
-    # the three shipped SLOs draw on three registries: freshness
+    # the shipped SLOs draw on three registries: freshness
     # histograms, the manager's skew gauge, and the process-global fleet
     # request counters the router increments
     history = MetricHistory(
@@ -736,7 +736,8 @@ def test_elasticdl_slo_reports_live_fleet(tmp_path, capsys):
         printed = capsys.readouterr().out
         # the CLI prints the exact bytes render_slo produces in-process
         assert printed.rstrip("\n") == render_slo(payload)
-        for name in ("staleness_p99", "fleet_skew", "predict_availability"):
+        for name in ("staleness_p99", "fleet_skew", "predict_availability",
+                     "predict_shed_ratio"):
             assert name in printed
         assert "OK" in printed
         assert "history:" in printed
@@ -748,6 +749,7 @@ def test_elasticdl_slo_reports_live_fleet(tmp_path, capsys):
             "staleness_p99": "ok",
             "fleet_skew": "ok",
             "predict_availability": "ok",
+            "predict_shed_ratio": "ok",
         }
     finally:
         server.stop()
@@ -778,3 +780,48 @@ def test_elasticdl_slo_reports_missing_evaluator(capsys):
         server.stop()
     assert rc == 1
     assert "no SLO evaluator" in capsys.readouterr().err
+
+
+def test_fleet_scale_fault_aborts_atomically_then_retries():
+    """The `fleet.scale` ROBUSTNESS.md row: an injected apiserver error
+    fires BEFORE any mutation, so an aborted scale action places
+    nothing, retires nothing, and leaves router membership untouched —
+    the serving policy engine simply retries it next tick."""
+    k8s = FakeK8sClient()
+    router = FleetRouter(retry_policy=_no_sleep_policy())
+    manager = ServingFleetManager(
+        k8s, ServingFleetConfig(replicas=1, interval_s=0.0),
+        job_name="scalefleet",
+        client_factory=lambda rid, addr: object(),  # no probes run here
+        router=router,
+    )
+    manager.place()
+    faults.install(FaultRegistry([
+        FaultSpec(faults.POINT_FLEET_SCALE, 0, "raise"),
+    ]))
+    record = manager.scale_up(2)
+    assert record["action"] == "scale_aborted"
+    assert manager.live_replicas() == 1
+    assert router.replica_ids() == [0]
+
+    record = manager.scale_up(2)            # fault plan exhausted
+    assert record["action"] == "scale_up"
+    assert record["replicas"] == [1, 2]
+    assert manager.live_replicas() == 3
+    assert router.replica_ids() == [0, 1, 2]
+
+    faults.uninstall()
+    faults.install(FaultRegistry([
+        FaultSpec(faults.POINT_FLEET_SCALE, 0, "raise"),
+    ]))
+    record = manager.scale_down(1)
+    assert record["action"] == "scale_aborted"
+    assert manager.live_replicas() == 3
+
+    record = manager.scale_down(1)
+    assert record["action"] == "scale_down"
+    assert manager.live_replicas() == 2
+    assert len(router.replica_ids()) == 2
+    snap = manager.snapshot()
+    assert snap["scale_ups"] == 2
+    assert snap["scale_downs"] == 1

@@ -1,0 +1,269 @@
+"""ServingPolicyEngine unit coverage (docs/SERVING.md "Autoscaling &
+backpressure"): hysteresis streaks gate every action, post-action holds
+quiet the loop, the rolling-reload guard and the `fleet.scale` fault
+point defer an action WITHOUT resetting its streak, bounds clamp to
+[min_replicas, max_replicas], and every decision is a literal-vocabulary
+`serving_scale` event plus a clock-free record."""
+
+import types
+
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common.events import (
+    SERVING_SCALE_ACTIONS,
+    SERVING_SCALE_REASONS,
+)
+from elasticdl_tpu.master.policy import (
+    ServingPolicyConfig,
+    ServingPolicyEngine,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    faults.uninstall()
+    events.configure(None)
+
+
+class FakeFleet:
+    """Just the surface the engine touches: live count, the idle-aware
+    fill signal, the projected-skew guard input, and recording
+    scale_up/scale_down actuators."""
+
+    def __init__(self, live=1, skew_slo=0):
+        self.config = types.SimpleNamespace(step_skew_slo=skew_slo)
+        self._live = live
+        self.fill = 0.0
+        self.skew = 0
+        self.abort_next = False
+        self.calls = []
+
+    def live_replicas(self):
+        return self._live
+
+    def fill_signal(self):
+        return self.fill
+
+    def projected_scale_skew(self):
+        return self.skew
+
+    def scale_up(self, step):
+        self.calls.append(("up", step))
+        if self.abort_next:
+            self.abort_next = False
+            return {"action": "scale_aborted", "replicas": []}
+        added = list(range(self._live, self._live + step))
+        self._live += step
+        return {"action": "scale_up", "replicas": added}
+
+    def scale_down(self, step, prefer="unhealthy"):
+        self.calls.append(("down", step, prefer))
+        if self.abort_next:
+            self.abort_next = False
+            return {"action": "scale_aborted", "replicas": []}
+        victims = list(range(self._live - step, self._live))
+        self._live -= step
+        return {"action": "scale_down", "replicas": victims}
+
+
+class FakeEvaluator:
+    def __init__(self, burn=0.0):
+        self.burn = burn
+
+    def max_burn(self):
+        return self.burn
+
+
+class FakeHistory:
+    """counter_delta per series over the evidence window."""
+
+    def __init__(self, offered=0.0, sheds=0.0):
+        self.offered = offered
+        self.sheds = sheds
+
+    def counter_delta(self, series, window_s):
+        if series == "rpc_fleet_requests_total":
+            return self.offered
+        if series == "rpc_fleet_sheds_total":
+            return self.sheds
+        return 0.0
+
+
+def _engine(fleet, evaluator=None, history=None, **cfg_kwargs):
+    defaults = dict(
+        min_replicas=1, max_replicas=4, up_ticks=2, down_ticks=3,
+        scale_hold_ticks=2, scale_step=1,
+    )
+    defaults.update(cfg_kwargs)
+    return ServingPolicyEngine(
+        fleet, ServingPolicyConfig(**defaults),
+        history=history, evaluator=evaluator, clock=lambda: 0.0,
+    )
+
+
+def test_burn_streak_gates_scale_up_and_hold_quiets():
+    fleet = FakeFleet(live=1)
+    engine = _engine(fleet, evaluator=FakeEvaluator(burn=5.0))
+    assert engine.tick() is None            # streak 1 < up_ticks
+    record = engine.tick()                  # streak 2 -> action
+    assert record["action"] == "scale_up"
+    assert record["reason"] == "burn_rate"
+    assert fleet.live_replicas() == 2
+    # post-action hold: two quiet ticks even though burn stays high
+    assert engine.tick() is None
+    assert engine.tick() is None
+    # the streak kept accumulating through the hold (signals refresh
+    # before the hold check), so the first post-hold tick acts
+    assert engine.tick()["action"] == "scale_up"
+    assert fleet.live_replicas() == 3
+
+
+def test_shed_ratio_scales_up_before_the_slo_burns():
+    fleet = FakeFleet(live=1)
+    engine = _engine(
+        fleet, evaluator=FakeEvaluator(burn=0.0),
+        history=FakeHistory(offered=100.0, sheds=10.0),
+    )
+    engine.tick()
+    record = engine.tick()
+    assert record["action"] == "scale_up"
+    assert record["reason"] == "shed_ratio"
+    assert record["shed_ratio"] == 0.1
+
+
+def test_max_replicas_clamps_scale_up():
+    fleet = FakeFleet(live=4)
+    engine = _engine(fleet, evaluator=FakeEvaluator(burn=9.0))
+    for _ in range(6):
+        assert engine.tick() is None
+    assert fleet.calls == []
+
+
+def test_calm_underfilled_fleet_scales_down_to_min():
+    fleet = FakeFleet(live=3)
+    fleet.fill = 0.0
+    engine = _engine(
+        fleet, evaluator=FakeEvaluator(burn=0.0),
+        history=FakeHistory(offered=40.0, sheds=0.0),
+        down_ticks=2, scale_hold_ticks=1,
+    )
+    assert engine.tick() is None
+    record = engine.tick()
+    assert record["action"] == "scale_down"
+    assert record["reason"] == "batch_fill"
+    assert engine.tick() is None            # hold (streak keeps building)
+    record = engine.tick()
+    assert record["action"] == "scale_down"
+    assert fleet.live_replicas() == 1
+    # at min_replicas the down path is clamped
+    for _ in range(4):
+        assert engine.tick() is None
+    assert fleet.live_replicas() == 1
+
+
+def test_idle_fleet_scales_down_on_reason_idle():
+    fleet = FakeFleet(live=2)
+    engine = _engine(
+        fleet, evaluator=FakeEvaluator(burn=0.0),
+        history=FakeHistory(offered=0.0, sheds=0.0),
+        down_ticks=2,
+    )
+    engine.tick()
+    record = engine.tick()
+    assert record["action"] == "scale_down"
+    assert record["reason"] == "idle"
+
+
+def test_reload_guard_defers_with_streak_frozen():
+    fleet = FakeFleet(live=1, skew_slo=4)
+    fleet.skew = 10
+    engine = _engine(fleet, evaluator=FakeEvaluator(burn=5.0))
+    engine.tick()
+    record = engine.tick()
+    assert record["action"] == "scale_aborted"
+    assert record["reason"] == "reload_guard"
+    assert fleet.calls == []                # never reached the actuator
+    # reload sequence finishes -> the SAME streak fires the action at
+    # the very next tick (a guard must not cost the hysteresis window)
+    fleet.skew = 0
+    assert engine.tick()["action"] == "scale_up"
+
+
+def test_fleet_scale_fault_aborts_atomically_and_retries():
+    fleet = FakeFleet(live=1)
+    fleet.abort_next = True
+    engine = _engine(fleet, evaluator=FakeEvaluator(burn=5.0))
+    engine.tick()
+    record = engine.tick()
+    assert record["action"] == "scale_aborted"
+    assert record["reason"] == "fault"
+    assert fleet.live_replicas() == 1       # nothing mutated
+    # streaks frozen: the next tick retries the same action
+    assert engine.tick()["action"] == "scale_up"
+    assert fleet.live_replicas() == 2
+
+
+def test_serving_pressure_is_burn_times_shed():
+    fleet = FakeFleet(live=1)
+    engine = _engine(
+        fleet, evaluator=FakeEvaluator(burn=4.0),
+        history=FakeHistory(offered=100.0, sheds=50.0),
+        up_ticks=99,
+    )
+    engine.tick()
+    assert engine.serving_pressure() == pytest.approx(2.0)
+
+
+def test_decisions_are_clock_free_and_events_literal():
+    seen = []
+    events.add_observer(seen.append)
+    try:
+        fleet = FakeFleet(live=1)
+        engine = _engine(fleet, evaluator=FakeEvaluator(burn=5.0))
+        engine.tick()
+        engine.tick()
+    finally:
+        events.remove_observer(seen.append)
+    record = engine.decisions[-1]
+    assert set(record) >= {"tick", "action", "reason"}
+    assert not any("time" in key or "unix" in key for key in record)
+    scales = [e for e in seen if e.get("event") == events.SERVING_SCALE]
+    assert scales
+    assert all(e["action"] in SERVING_SCALE_ACTIONS for e in scales)
+    assert all(e["reason"] in SERVING_SCALE_REASONS for e in scales)
+
+
+def test_record_rejects_out_of_vocabulary():
+    engine = _engine(FakeFleet(), evaluator=FakeEvaluator())
+    with pytest.raises(AssertionError):
+        engine._record("explode", "burn_rate")
+    with pytest.raises(AssertionError):
+        engine._record("scale_up", "vibes")
+
+
+def test_snapshot_shape_and_from_args():
+    engine = _engine(FakeFleet(live=2), evaluator=FakeEvaluator(3.0))
+    engine.tick()
+    snap = engine.snapshot()
+    for key in ("ticks", "up_streak", "down_streak", "hold_ticks",
+                "burn", "shed_ratio", "fill", "serving_pressure",
+                "min_replicas", "max_replicas", "live_replicas",
+                "decisions"):
+        assert key in snap
+    assert snap["live_replicas"] == 2
+
+    args = types.SimpleNamespace(
+        serving_replicas=2, min_serving_replicas=0,
+        max_serving_replicas=6, serving_policy_interval=0.0,
+        serving_burn_threshold=2.0, serving_shed_threshold=0.05,
+        serving_fill_low=0.3, serving_up_ticks=3, serving_down_ticks=4,
+        serving_scale_step=2, serving_scale_hold_ticks=1,
+        serving_shed_window_s=15.0,
+    )
+    cfg = ServingPolicyConfig.from_args(args)
+    assert cfg.min_replicas == 2            # defaults to serving_replicas
+    assert cfg.max_replicas == 6
+    assert cfg.burn_threshold == 2.0
+    assert cfg.scale_step == 2

@@ -18,6 +18,7 @@ from elasticdl_tpu.common.slo import (
     SLO_FLEET_SKEW,
     SLO_NAMES,
     SLO_PREDICT_AVAILABILITY,
+    SLO_PREDICT_SHED_RATIO,
     SLO_STALENESS_P99,
     STATE_BREACH,
     STATE_NO_DATA,
@@ -330,6 +331,7 @@ def _gauge_spec(**overrides):
 def test_spec_vocabulary_is_closed():
     assert SLO_NAMES == {
         SLO_STALENESS_P99, SLO_FLEET_SKEW, SLO_PREDICT_AVAILABILITY,
+        SLO_PREDICT_SHED_RATIO,
     }
     with pytest.raises(AssertionError):
         SloSpec(name="made_up", kind="gauge", series="s", objective=1.0)
@@ -343,6 +345,7 @@ def test_spec_vocabulary_is_closed():
     names = [spec.name for spec in shipped_specs()]
     assert names == [
         SLO_STALENESS_P99, SLO_FLEET_SKEW, SLO_PREDICT_AVAILABILITY,
+        SLO_PREDICT_SHED_RATIO,
     ]
 
 

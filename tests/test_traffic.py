@@ -1,0 +1,202 @@
+"""Traffic generator acceptance (docs/SERVING.md "Autoscaling &
+backpressure"): the offered schedule is a pure function of (seed,
+config, tick) — byte-identical across same-seed runs, profiles come
+from the closed TRAFFIC_PROFILES vocabulary, request shapes from the
+closed REQUEST_SHAPES catalog, and an injected `traffic.tick` fault
+stalls exactly one tick without shifting the schedule of any other
+(docs/ROBUSTNESS.md).  Also pins `scripts/online_summary.py`'s
+TRAFFIC_SUMMARY numbers to the tested behaviour."""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.proto import serving_pb2 as spb
+from elasticdl_tpu.traffic import (
+    REQUEST_SHAPES,
+    TRAFFIC_PROFILES,
+    TrafficConfig,
+    TrafficGenerator,
+    router_request_fn,
+)
+
+SEED = 20260807
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    faults.uninstall()
+    events.configure(None)
+
+
+def _recording_fn(outcome="ok"):
+    calls = []
+
+    def request_fn(client_id, rows, payload_seed):
+        calls.append((client_id, rows, payload_seed))
+        return outcome
+
+    return request_fn, calls
+
+
+def test_profile_vocabulary_is_closed():
+    assert TRAFFIC_PROFILES == {"poisson", "spike", "diurnal", "ramp"}
+    with pytest.raises(AssertionError):
+        TrafficConfig(profile="thundering_herd")
+
+
+def test_profile_factors_shape_the_load():
+    spike = TrafficGenerator(_recording_fn()[0], TrafficConfig(
+        profile="spike", spike_at_tick=4, spike_ticks=3, spike_factor=5.0,
+    ))
+    assert spike._factor(3) == 1.0
+    assert spike._factor(4) == 5.0
+    assert spike._factor(6) == 5.0
+    assert spike._factor(7) == 1.0
+
+    ramp = TrafficGenerator(_recording_fn()[0], TrafficConfig(
+        profile="ramp", ramp_ticks=10, spike_factor=3.0,
+    ))
+    factors = [ramp._factor(t) for t in range(12)]
+    assert factors == sorted(factors)       # monotone climb
+    assert factors[0] == 1.0
+    assert factors[10] == factors[11] == 3.0  # clamps at the peak
+
+    diurnal = TrafficGenerator(_recording_fn()[0], TrafficConfig(
+        profile="diurnal", diurnal_period_ticks=8, amplitude=2.0,
+    ))
+    assert all(diurnal._factor(t) >= 0.0 for t in range(16))
+
+
+def test_same_seed_is_byte_identical_different_seed_is_not():
+    runs = []
+    for seed in (SEED, SEED, SEED + 1):
+        fn, calls = _recording_fn()
+        gen = TrafficGenerator(fn, TrafficConfig(
+            profile="diurnal", base_qps=20.0, seed=seed,
+        ))
+        gen.run(12)
+        runs.append((json.dumps(gen.snapshot(), sort_keys=True), calls))
+    assert runs[0][0] == runs[1][0]
+    assert runs[0][1] == runs[1][1]         # every (client, rows, seed)
+    assert runs[0][0] != runs[2][0]
+
+
+def test_request_shapes_come_from_the_closed_catalog():
+    fn, calls = _recording_fn()
+    gen = TrafficGenerator(fn, TrafficConfig(base_qps=30.0, seed=SEED,
+                                             clients=3))
+    gen.run(6)
+    assert calls
+    assert all(rows in REQUEST_SHAPES for _, rows, _ in calls)
+    assert all(0 <= cid < 3 for cid, _, _ in calls)
+
+
+def test_outcomes_tally_into_counters():
+    outcomes = iter(["ok", "shed", "failed"] * 1000)
+    gen = TrafficGenerator(
+        lambda *_a: next(outcomes),
+        TrafficConfig(base_qps=15.0, seed=SEED),
+    )
+    gen.run(4)
+    snap = gen.snapshot()
+    assert snap["offered"] == snap["ok"] + snap["shed"] + snap["failed"]
+    assert snap["offered"] == sum(snap["schedule"])
+    assert snap["shed_ratio"] == pytest.approx(
+        snap["shed"] / snap["offered"], abs=1e-4
+    )
+
+
+def test_unknown_outcome_is_rejected():
+    gen = TrafficGenerator(lambda *_a: "maybe",
+                           TrafficConfig(base_qps=30.0, seed=SEED))
+    with pytest.raises(AssertionError):
+        gen.run(3)
+
+
+def test_tick_fault_stalls_one_tick_without_shifting_the_schedule():
+    """The ROBUSTNESS.md row for `traffic.tick`: chaos stalls the load
+    source for one tick; the planned schedule — and every executed tick
+    around the stall — replays byte-identically."""
+    fn_clean, calls_clean = _recording_fn()
+    clean = TrafficGenerator(fn_clean, TrafficConfig(
+        profile="spike", base_qps=10.0, seed=SEED, spike_at_tick=3,
+        spike_ticks=2,
+    ))
+    clean.run(8)
+
+    faults.install(FaultRegistry([
+        FaultSpec(faults.POINT_TRAFFIC_TICK, 2, "raise"),
+    ]))
+    fn_chaos, calls_chaos = _recording_fn()
+    chaos = TrafficGenerator(fn_chaos, TrafficConfig(
+        profile="spike", base_qps=10.0, seed=SEED, spike_at_tick=3,
+        spike_ticks=2,
+    ))
+    chaos.run(8)
+    assert faults.get_registry().all_fired()
+
+    # the planned schedule is untouched by the fault...
+    assert chaos.schedule == clean.schedule
+    # ...the faulted tick offered nothing and says so...
+    faulted = [r for r in chaos.log if r["faulted"]]
+    assert [r["tick"] for r in faulted] == [2]
+    assert faulted[0]["offered"] == 0
+    assert chaos.snapshot()["tick_faults"] == 1
+    # ...and every OTHER tick executed the exact same requests: the
+    # clean run minus exactly the faulted tick's block.
+    assert calls_chaos == (
+        calls_clean[:sum(clean.schedule[:2])]
+        + calls_clean[sum(clean.schedule[:3]):]
+    )
+    assert chaos.snapshot()["offered"] == (
+        clean.snapshot()["offered"] - clean.schedule[2]
+    )
+
+
+def test_router_request_fn_classifies_the_proto_vocabulary():
+    class FakeRouter:
+        def __init__(self):
+            self.mode = "ok"
+
+        def predict(self, request, timeout=None):
+            if self.mode == "raise":
+                raise ConnectionError("fleet down")
+            if self.mode == "drop":
+                raise faults.DroppedRequest("lost in flight")
+            response = spb.PredictResponse()
+            response.code = (
+                spb.SERVING_OK if self.mode == "ok"
+                else spb.SERVING_OVERLOADED
+            )
+            return response
+
+    router = FakeRouter()
+    fn = router_request_fn(
+        router, lambda rows, seed: np.zeros((rows, 4), np.float32)
+    )
+    assert fn(0, 2, 123) == "ok"
+    router.mode = "shed"
+    assert fn(0, 2, 123) == "shed"
+    router.mode = "raise"
+    assert fn(0, 2, 123) == "failed"
+    router.mode = "drop"
+    assert fn(0, 2, 123) == "failed"
+
+
+def test_traffic_summary_spike_scales_without_failures():
+    """CI's TRAFFIC_SUMMARY line (scripts/run_tests.sh): the seeded
+    spike against the capacity-gated autoscaling fleet sheds during the
+    spike, triggers at least one scale action, and fails nothing."""
+    from scripts.online_summary import traffic_summary
+
+    summary = traffic_summary(ticks=8)
+    assert summary["failed_requests"] == 0
+    assert summary["offered_qps"] > 0
+    assert summary["shed_ratio"] > 0      # the gate made overload real
+    assert summary["scale_actions"] >= 1  # and the policy engine acted
+    assert summary["fleet"] >= 2
