@@ -1895,9 +1895,12 @@ def bench_tiered(
     parity_batch: int = 128,
     throughput_steps: int = 24,
     throughput_batch: int = 128,
+    cache_dtype: str = "float32",
+    fused_k: int = 8,
 ):
-    """Tiered embedding store bench (`python bench.py --tiered`,
-    docs/PERF.md "Tiered embedding store").  Four sub-benches:
+    """Tiered embedding store bench (`python bench.py --tiered`, or
+    `--tiered --cache_dtype int8` for the quantized device cache;
+    docs/PERF.md "Tiered embedding store").  Six sub-benches:
 
     1. EXACT parity vs the flat arena on an all-hot working set: the
        host tier is backfilled from the flat model's init table over a
@@ -1916,6 +1919,24 @@ def bench_tiered(
        plus the cold-gather overlap share (fraction of host-gather
        seconds absorbed by the prefetcher thread instead of the
        consumer's critical path).
+    5. Analytic device-cache bytes (ISSUE 18a): fp32 vs int8 VALUE
+       bytes at capacity and per step, aggregate and per plane — the
+       carrier + Adam moments are identical in both modes and the
+       forward never reads the carrier's bytes (XLA folds the
+       exact-zero add), so they cancel; the headline reduction is the
+       quantized embedding plane's (the byte-dominant one), with the
+       aggregate (diluted by the dim-1 linear plane's fixed scale
+       overhead) reported alongside.
+    6. K-step fused-block parity (ISSUE 18c, `fused_k` steps via ONE
+       `train_on_batch_stack` scan with a union admission block) vs
+       the flat arena driven through the SAME K-step scan — the
+       bitwise train-path contract of sub-bench 1 extended to
+       steps_per_execution > 1.
+
+    `cache_dtype="int8"` runs 1/4/6 with the quantized device cache:
+    the bitwise-vs-flat contract only holds for fp32 (int8 admissions
+    quantize the backfilled values), so parity fields are reported but
+    gated only when `parity_gated` says so.
     """
     import time as _time
 
@@ -1982,7 +2003,8 @@ def bench_tiered(
     )
     _, tier_tr = _trainer_for(
         "deepfm.deepfm_tiered.custom_model",
-        model_params=f"cache_rows={cache_rows};embed_dim={dim}",
+        model_params=(f"cache_rows={cache_rows};embed_dim={dim};"
+                      f"cache_dtype='{cache_dtype}'"),
     )
     b0 = parity_batch_at(0)
     flat_state = flat_tr.init_state(jax.random.PRNGKey(0), b0["features"])
@@ -2000,7 +2022,8 @@ def bench_tiered(
         for name in ("fm_embedding", "fm_linear")
     }
     store = TieredStore(
-        {"fm_embedding": dim, "fm_linear": 1}, NUM_SPARSE, cache_rows
+        {"fm_embedding": dim, "fm_linear": 1}, NUM_SPARSE, cache_rows,
+        cache_dtype=cache_dtype,
     )
     # admitted rows start at the flat model's init values, so the two
     # runs share their step-0 state exactly
@@ -2058,13 +2081,17 @@ def bench_tiered(
         "batch_size": parity_batch,
         "working_set_rows": int(NUM_SPARSE * ids_per_field),
         "cache_rows": cache_rows,
+        "cache_dtype": cache_dtype,
         "max_abs_loss_diff": max_loss_diff,
         "max_abs_trained_row_diff": row_diff,
         # Train-path parity is the bitwise claim: per-step losses prove
         # the forward program, trained rows prove the backward.  Predict
         # compiles a SEPARATE program per model (different gather table
         # shapes -> different XLA fusion order), so its diff is allowed
-        # to be a few ulp and is reported, not gated on.
+        # to be a few ulp and is reported, not gated on.  The bitwise
+        # claim is an FP32-cache contract: an int8 cache quantizes
+        # admissions, so its diffs vs flat are reported, not gated.
+        "parity_gated": cache_dtype == "float32",
         "exact": bool(max_loss_diff == 0.0 and row_diff == 0.0),
         "predict_max_abs_diff": pred_diff,
         "predict_within_few_ulp": bool(pred_diff <= 4 * np.finfo(np.float32).eps),
@@ -2181,14 +2208,15 @@ def bench_tiered(
 
     _, tier_tp = _trainer_for(
         "deepfm.deepfm_tiered.custom_model",
-        model_params=f"cache_rows={tp_cache};embed_dim={tp_dim}",
+        model_params=(f"cache_rows={tp_cache};embed_dim={tp_dim};"
+                      f"cache_dtype='{cache_dtype}'"),
     )
     from elasticdl_tpu.common.profiler import PhaseTimer
 
     timer = PhaseTimer(flush_every=1 << 30)
     tp_store = TieredStore(
         {"fm_embedding": tp_dim, "fm_linear": 1}, NUM_SPARSE, tp_cache,
-        phase_timer=timer,
+        phase_timer=timer, cache_dtype=cache_dtype,
     )
     tier_tp.tiered_store = tp_store
     tp_store.start()
@@ -2231,11 +2259,287 @@ def bench_tiered(
             (tp_stats["cold_gather_async_s"]
              + tp_stats["cold_gather_sync_s"]) / tier_s, 4
         ),
+        "cache_dtype": cache_dtype,
     }
+
+    # ---- 5. analytic device-cache bytes, fp32 vs int8 ------------------
+    from elasticdl_tpu.store.cache import (
+        cache_value_bytes_per_row,
+        device_cache_bytes,
+        device_cache_bytes_per_step,
+    )
+
+    ana_planes = {"fm_embedding": tp_dim, "fm_linear": 1}
+    lookups = throughput_batch * NUM_SPARSE
+    fp32_total = device_cache_bytes(ana_planes, tp_cache, "float32")
+    int8_total = device_cache_bytes(ana_planes, tp_cache, "int8")
+    emb_fp32 = cache_value_bytes_per_row(tp_dim, "float32")
+    emb_int8 = cache_value_bytes_per_row(tp_dim, "int8")
+    detail["device_cache_bytes"] = {
+        "cache_dtype": cache_dtype,
+        "planes": ana_planes,
+        "cache_rows": tp_cache,
+        "lookups_per_step": lookups,
+        "fp32_bytes_at_capacity": fp32_total,
+        "int8_bytes_at_capacity": int8_total,
+        "fp32_bytes_per_step": device_cache_bytes_per_step(
+            ana_planes, lookups, "float32"
+        ),
+        "int8_bytes_per_step": device_cache_bytes_per_step(
+            ana_planes, lookups, "int8"
+        ),
+        "device_cache_bytes_per_step": device_cache_bytes_per_step(
+            ana_planes, lookups, cache_dtype
+        ),
+        # Headline on the byte-dominant quantized embedding plane
+        # (dim 16: 64 -> 20 bytes/row = 3.2x; equivalently 3.2x more
+        # resident embedding rows at an equal byte budget).  The
+        # aggregate is diluted by the dim-1 linear plane, whose fixed
+        # 4-byte per-row scale nearly cancels its code savings.
+        "embedding_plane_bytes_fp32": emb_fp32,
+        "embedding_plane_bytes_int8": emb_int8,
+        "embedding_plane_reduction": round(emb_fp32 / emb_int8, 3),
+        "equal_budget_resident_rows_multiplier": round(
+            emb_fp32 / emb_int8, 3
+        ),
+        "aggregate_reduction": round(fp32_total / int8_total, 3),
+        "reduction_at_least_3x": bool(emb_fp32 / emb_int8 >= 3.0),
+    }
+
+    # ---- 6. K-step fused-block parity vs flat --------------------------
+    # Both models run the SAME K-step lax.scan program shape
+    # (train_on_batch_stack); the tiered side plans ONE union admission
+    # block before the scan (prepare_block via the deferred path).  For
+    # an fp32 cache the per-step losses must stay bitwise identical to
+    # flat — sub-bench 1's contract extended to steps_per_execution>1.
+    if fused_k > 1:
+        fb_store = TieredStore(
+            {"fm_embedding": dim, "fm_linear": 1}, NUM_SPARSE,
+            cache_rows, cache_dtype=cache_dtype,
+        )
+        fb_store.host.set_backfill(
+            lambda plane, fields, ids: flat_init[plane][
+                hash_rows(fields, ids, cap)
+            ]
+        )
+        fb_store.enable_deferred_prepare()
+        tier_tr.tiered_store = fb_store
+        fb_flat_state = flat_tr.init_state(
+            jax.random.PRNGKey(0), b0["features"]
+        )
+        fb_tier_state = tier_tr.init_state(
+            jax.random.PRNGKey(0),
+            {
+                "dense": b0["features"]["dense"],
+                "slots": np.zeros((parity_batch, NUM_SPARSE), np.int32),
+            },
+        )
+        fb_batches = [parity_batch_at(20_000 + k) for k in range(fused_k)]
+        _, fb_flat_losses = flat_tr.train_on_batch_stack(
+            fb_flat_state, fb_batches
+        )
+        _, fb_tier_losses = tier_tr.train_on_batch_stack(
+            fb_tier_state,
+            [fb_store.attach(
+                {"features": dict(b["features"]), "labels": b["labels"]}
+            ) for b in fb_batches],
+        )
+        fb_flat_losses = np.asarray(jax.device_get(fb_flat_losses))
+        fb_tier_losses = np.asarray(jax.device_get(fb_tier_losses))
+        fb_diff = float(np.abs(fb_flat_losses - fb_tier_losses).max())
+        detail["fused_block"] = {
+            "k": int(fused_k),
+            "cache_dtype": cache_dtype,
+            "block_plans": fb_store.stats()["block_plans"],
+            "flat_losses": [float(x) for x in fb_flat_losses],
+            "tiered_losses": [float(x) for x in fb_tier_losses],
+            "max_abs_loss_diff": fb_diff,
+            "parity_gated": cache_dtype == "float32",
+            "exact": bool(fb_diff == 0.0),
+        }
+
     return {
         "bench": "tiered",
         "value": detail["throughput"]["tiered_examples_per_sec"],
         "unit": "examples/sec",
+        "detail": detail,
+    }
+
+
+def _tiered_multichip_child(n_devices: int = 8,
+                            cache_dtype: str = "float32",
+                            steps: int = 6, seed: int = 0):
+    """Child half of `bench_tiered_multichip` — assumes jax already sees
+    `n_devices` devices (the parent re-execs us under a virtual CPU
+    mesh).  Trains a tiered DeepFM whose cache tables row-shard over an
+    n-way `model` mesh axis, then prints one JSON line with the
+    per-chip embedding byte split (measured from the arrays'
+    addressable shards, not inferred) and a checksum of the cache
+    values for the parent's same-seed byte-stability check."""
+    import zlib
+
+    import jax
+
+    import model_zoo.deepfm.deepfm_tiered as zoo
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    cache_rows, dim, batch, ids_per_field = 4096, 16, 128, 40
+    mesh = mesh_lib.create_mesh(data=1, model=n_devices)
+    model = zoo.custom_model(
+        cache_rows=cache_rows, embed_dim=dim, cache_dtype=cache_dtype
+    )
+    tr = Trainer(model=model, optimizer=zoo.optimizer(),
+                 loss_fn=zoo.loss,
+                 param_sharding_fn=zoo.param_sharding, mesh=mesh)
+    store = zoo.build_tiered_store()
+    store.set_mesh_shards(n_devices)
+    tr.tiered_store = store
+
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 1 << 22, (zoo.NUM_SPARSE, ids_per_field))
+
+    def batch_at(i):
+        brng = np.random.RandomState(seed * 1000 + i)
+        pick = brng.randint(0, ids_per_field, (batch, zoo.NUM_SPARSE))
+        return {
+            "features": {
+                "dense": brng.rand(batch, zoo.NUM_DENSE).astype(
+                    np.float32
+                ),
+                "sparse": ids[np.arange(zoo.NUM_SPARSE)[None, :], pick],
+            },
+            "labels": brng.randint(0, 2, batch).astype(np.int32),
+        }
+
+    state = tr.init_state(
+        jax.random.PRNGKey(seed),
+        {"dense": np.zeros((batch, zoo.NUM_DENSE), np.float32),
+         "slots": np.zeros((batch, zoo.NUM_SPARSE), np.int32)},
+    )
+    sub_plan_admits = []
+    for i in range(steps):
+        ab = store.attach(batch_at(i))
+        plan = ab.get("__store_plan__")
+        if plan is not None and plan.sub_plans is not None:
+            sub_plan_admits.append(
+                [int(sp["admit_slots"].size) for sp in plan.sub_plans]
+            )
+        state, loss = tr.train_on_batch(state, ab)
+    jax.device_get(loss)
+
+    # Per-chip bytes of every embedding-cache array — measured from
+    # where XLA actually placed the shards.  In int8 mode the fp32
+    # params are the zero gradient CARRIER (values live in the q8/scale
+    # planes); in fp32 mode the params ARE the values.  The split is
+    # reported so the int8 total isn't misread: the carrier is byte-wise
+    # identical in both modes and cancels out of any comparison, while
+    # the VALUE bytes shrink per the analytic model.
+    def cache_arrays():
+        for name in store.planes:
+            is_value = cache_dtype == "float32"
+            yield name, state.params["params"][name]["embedding"], is_value
+        if cache_dtype == "int8":
+            for name in store.planes:
+                planes = state.model_state["quantized"][name]["embedding"]
+                yield f"{name}.q8", planes["q8"], True
+                yield f"{name}.scale", planes["scale"], True
+
+    per_chip = {}
+    per_chip_value = {}
+    total = value_total = 0
+    crc = 0
+    for name, arr, is_value in cache_arrays():
+        total += arr.nbytes
+        value_total += arr.nbytes if is_value else 0
+        for sh in arr.addressable_shards:
+            dev = int(sh.device.id)
+            nbytes = int(sh.data.nbytes)
+            per_chip[dev] = per_chip.get(dev, 0) + nbytes
+            if is_value:
+                per_chip_value[dev] = per_chip_value.get(dev, 0) + nbytes
+        crc = zlib.crc32(
+            np.ascontiguousarray(jax.device_get(arr)).tobytes(), crc
+        )
+    print(json.dumps({
+        "n_devices": n_devices,
+        "cache_dtype": cache_dtype,
+        "steps": steps,
+        "cache_rows": cache_rows,
+        "embed_dim": dim,
+        "total_embedding_bytes": int(total),
+        "value_plane_bytes": int(value_total),
+        "carrier_bytes": int(total - value_total),
+        "per_chip_embedding_bytes": [
+            per_chip.get(d, 0) for d in range(n_devices)
+        ],
+        "per_chip_value_bytes": [
+            per_chip_value.get(d, 0) for d in range(n_devices)
+        ],
+        "sub_plan_admits_per_step": sub_plan_admits,
+        "final_loss": float(jax.device_get(loss)),
+        "cache_values_crc32": int(crc & 0xFFFFFFFF),
+    }))
+
+
+def bench_tiered_multichip(n_devices: int = 8,
+                           cache_dtype: str = "float32"):
+    """Mesh-sharded tiered seam over a virtual n-device mesh (ISSUE
+    18b): `python bench.py tiered-multichip [--cache_dtype int8]`.
+
+    Self-provisioning like `__graft_entry__.dryrun_multichip`: when the
+    host has fewer than n devices the measurement runs in a subprocess
+    with `JAX_PLATFORMS=cpu` + `--xla_force_host_platform_device_count`
+    — same chips-virtual/CPU-math methodology as MULTICHIP_r0*, so the
+    per-chip BYTE split is exact while absolute step time is not
+    TPU-representative.  Runs the child TWICE with the same seed and
+    gates on identical cache-value checksums (byte-stability) and on
+    per-chip embedding bytes == total/n on every chip (~linear
+    shrink)."""
+    import subprocess
+
+    from elasticdl_tpu.common.virtual_mesh import cpu_mesh_env
+
+    env = cpu_mesh_env(n_devices)
+    code = (
+        "import sys; sys.path.insert(0, {root!r})\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from elasticdl_tpu.common.virtual_mesh import "
+        "apply_compilation_cache_config\n"
+        "apply_compilation_cache_config()\n"
+        "import bench\n"
+        "bench._tiered_multichip_child({n}, cache_dtype={dt!r})\n"
+    ).format(root=_ROOT, n=n_devices, dt=cache_dtype)
+    runs = []
+    for _ in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, check=True,
+        )
+        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+    first, second = runs
+    per_chip = first["per_chip_embedding_bytes"]
+    total = first["total_embedding_bytes"]
+    detail = {
+        **first,
+        "byte_stable_across_same_seed_runs": bool(
+            first["cache_values_crc32"] == second["cache_values_crc32"]
+            and per_chip == second["per_chip_embedding_bytes"]
+        ),
+        "per_chip_is_total_over_n": bool(
+            all(b == total // n_devices for b in per_chip)
+        ),
+        "methodology": (
+            f"virtual {n_devices}-device CPU mesh "
+            "(--xla_force_host_platform_device_count, as MULTICHIP_r0*)"
+            ": per-chip bytes measured from addressable shards are "
+            "exact; absolute step time is not TPU-representative"
+        ),
+    }
+    return {
+        "bench": "tiered-multichip",
+        "value": max(per_chip),
+        "unit": "per_chip_embedding_bytes",
         "detail": detail,
     }
 
@@ -2255,6 +2559,19 @@ def _maybe_attach_metrics(result):
 def main():
     argv = [a for a in sys.argv[1:] if a != "--emit-metrics"]
     emit_metrics = len(argv) != len(sys.argv) - 1
+    # --cache_dtype {float32,int8} selects the tiered benches' device
+    # hot-row cache plane layout (ISSUE 18a).
+    cache_dtype = "float32"
+    rest = []
+    it = iter(argv)
+    for a in it:
+        if a == "--cache_dtype":
+            cache_dtype = next(it, cache_dtype)
+        elif a.startswith("--cache_dtype="):
+            cache_dtype = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    argv = rest
     which = argv[0] if argv else "full"
     which = which.lstrip("-")  # `--serving` and `serving` both work
     post = _maybe_attach_metrics if emit_metrics else (lambda r: r)
@@ -2273,7 +2590,11 @@ def main():
               "traffic": bench_traffic,
               "sparse-path": bench_sparse_path,
               "sparse_path": bench_sparse_path,
-              "tiered": bench_tiered,
+              "tiered": lambda: bench_tiered(cache_dtype=cache_dtype),
+              "tiered-multichip": lambda: bench_tiered_multichip(
+                  cache_dtype=cache_dtype),
+              "tiered_multichip": lambda: bench_tiered_multichip(
+                  cache_dtype=cache_dtype),
               "e2e": lambda: bench_deepfm_e2e()}[which]
         print(json.dumps(post(fn())))
 

@@ -65,6 +65,7 @@ def _train_local(args, job_type: str = "train") -> int:
             args, "prediction_outputs_processor", ""
         ),
         arena_dtype=getattr(args, "arena_dtype", ""),
+        store_cache_dtype=getattr(args, "store_cache_dtype", ""),
     )
     args.job_type = job_type
     if job_type in ("evaluate", "predict") and not args.checkpoint_dir_for_init:
@@ -166,13 +167,6 @@ def _train_local(args, job_type: str = "train") -> int:
     tiered_store = None
     build_tiered_store = getattr(spec.module, "build_tiered_store", None)
     if build_tiered_store is not None and job_type == "train":
-        if getattr(args, "steps_per_execution", 1) != 1:
-            raise ValueError(
-                "tiered embedding store requires --steps_per_execution 1:"
-                " each step's admissions must land on the state before "
-                "that step runs, which a fused multi-step dispatch "
-                "cannot interleave"
-            )
         if args.validation_data:
             raise ValueError(
                 "tiered embedding store does not support mid-train "
@@ -190,6 +184,18 @@ def _train_local(args, job_type: str = "train") -> int:
             registry=metrics_lib.default_registry(),
             phase_timer=_phase_timer,
         )
+        if getattr(args, "steps_per_execution", 1) != 1:
+            # Fused multi-step (ISSUE 18c): the K steps run as one
+            # uninterruptible scan, so per-batch eager plans are
+            # impossible — the trainer plans ONE admission block over
+            # the union of the K batches' rows at train time, which
+            # requires the raw sparse batches (deferred mode) rather
+            # than pre-planned slots.
+            tiered_store.enable_deferred_prepare()
+            logger.info(
+                "Tiered store: deferred block planning for "
+                "steps_per_execution=%d", args.steps_per_execution,
+            )
         if args.num_workers != 1:
             # Multi-worker path: N feed producers cannot keep the strict
             # batch-order invariant eager planning needs, so planning is
@@ -206,12 +212,20 @@ def _train_local(args, job_type: str = "train") -> int:
         spec.feed = tiered_store.wrap_feed(spec.feed)
         spec.feed_bulk = tiered_store.wrap_feed(spec.feed_bulk)
         owner.trainer.tiered_store = tiered_store
+        # Mesh-sharded seam (ISSUE 18b): declare the model-axis size so
+        # plans carry per-chip sub-plans and per-chip byte accounting
+        # matches the row-sharded cache tables XLA actually partitions.
+        model_shards = int(dict(owner.trainer.mesh.shape).get("model", 1))
+        if model_shards > 1:
+            tiered_store.set_mesh_shards(model_shards)
         if owner.checkpoint_saver is not None:
             owner.checkpoint_saver.attach_tiered_store(tiered_store)
         tiered_store.start()
         logger.info(
-            "Tiered embedding store active: cache_rows=%d host_dtype=%s",
+            "Tiered embedding store active: cache_rows=%d host_dtype=%s "
+            "cache_dtype=%s mesh_shards=%d",
             tiered_store.cache_rows, tiered_store.host.host_dtype,
+            tiered_store.cache_dtype, tiered_store.mesh_shards,
         )
 
     # A restored task journal may already be terminal; the finish check
@@ -347,6 +361,7 @@ def build_serving_server(args):
     spec = get_model_spec(
         args.model_zoo, args.model_def, model_params=args.model_params,
         arena_dtype=getattr(args, "arena_dtype", ""),
+        store_cache_dtype=getattr(args, "store_cache_dtype", ""),
     )
     buckets = tuple(
         int(b) for b in str(args.batch_buckets).split(",") if b.strip()

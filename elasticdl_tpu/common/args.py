@@ -406,6 +406,16 @@ def add_model_params(parser: argparse.ArgumentParser):
         "(float32).  Forwarded into model_params for zoos whose "
         "custom_model accepts arena_dtype.",
     )
+    parser.add_argument(
+        "--store_cache_dtype", default="",
+        choices=["", "float32", "int8"],
+        help="Tiered-store device hot-row cache storage dtype: int8 "
+        "stores cache rows as quantized codes with per-row fp32 scales "
+        "(docs/PERF.md §4).  Empty defers to the model's default "
+        "(float32).  Forwarded into model_params as cache_dtype for "
+        "zoos whose custom_model accepts it; zoos without tiered "
+        "support ignore it.",
+    )
     parser.add_argument("--dataset_fn", default="feed")
     parser.add_argument("--loss", default="loss")
     parser.add_argument("--optimizer", default="optimizer")

@@ -316,6 +316,7 @@ class CheckpointSaver:
                 "cache_rows": int(store.cache_rows),
                 "vocab_rows": int(store.host.size),
                 "host_dtype": store.host.host_dtype,
+                "cache_dtype": getattr(store, "cache_dtype", "float32"),
                 "planes": {
                     name: int(dim) for name, dim in store.planes.items()
                 },
@@ -338,12 +339,20 @@ class CheckpointSaver:
             )
             return
         sidecar = store_ckpt.load_sidecar(self._dir, step)
+        # convert=True: when the sidecar's plane dtype differs from the
+        # running store's, the device cache VALUES restore through this
+        # saver's template (arena_convert handles the int8<->fp32 plane
+        # migration on the TrainState), so the residency map is safe to
+        # adopt across the dtype change — the strict dtype gate is for
+        # callers restoring bookkeeping WITHOUT the values.
         self._tiered_store.load_sidecar_state(
-            sidecar.host_state, sidecar.row_of, sidecar.score
+            sidecar.host_state, sidecar.row_of, sidecar.score,
+            cache_dtype=sidecar.cache_dtype, convert=True,
         )
         logger.info(
             "tiered store sidecar restored for step %d "
-            "(vocab_rows=%d)", step, sidecar.meta.get("vocab_rows", -1),
+            "(vocab_rows=%d cache_dtype=%s)", step,
+            sidecar.meta.get("vocab_rows", -1), sidecar.cache_dtype,
         )
 
     def save(self, state, force: bool = False) -> bool:

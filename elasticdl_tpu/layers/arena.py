@@ -328,25 +328,74 @@ class TieredArena(nn.Module):
     exactly those positions; overlay values are stop_gradient'ed (cold
     rows train host-side via the store's fold path, never through the
     device optimizer).
+
+    `cache_dtype="int8"` quantizes the CACHE storage exactly like
+    `EmbeddingArena`'s int8 mode: q8 codes + per-row fp32 scales in the
+    "quantized" collection, dequantized inside the same fused gather, a
+    zero fp32 carrier param (same "embedding" name/shape, so sharding /
+    opt_state / checkpoint structure are mode-invariant) collecting the
+    scatter-add gradient via `_grad_tap`, and the per-step optimizer
+    delta folded back into the codes by the SAME `fold_quantized_updates`
+    the flat int8 arena uses — the trainer already calls it
+    unconditionally.  Admissions quantize host values into the planes
+    through `store/device.py` (the store-side GL-QUANT allowlist).
     """
 
     cache_rows: int
     output_dim: int
     param_dtype: jnp.dtype = jnp.float32
+    cache_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, slots, overlay=None):
-        # Same initializer as the flat arena: a slot that is never
-        # admitted before first use behaves like a fresh flat-arena row.
-        table = self.param(
-            "embedding",
-            nn.initializers.normal(stddev=0.05),
-            (int(self.cache_rows), self.output_dim),
-            self.param_dtype,
-        )
+        if self.cache_dtype not in ARENA_DTYPES:
+            raise ValueError(
+                f"cache_dtype must be one of {ARENA_DTYPES}, got "
+                f"{self.cache_dtype!r}"
+            )
+        shape = (int(self.cache_rows), self.output_dim)
+        if self.cache_dtype == "int8":
+            carrier = self.param(
+                "embedding", nn.initializers.zeros, shape, jnp.float32
+            )
+
+            def _init_planes():
+                # Same init DISTRIBUTION as the fp32 cache (and the flat
+                # arena): a never-admitted slot behaves like a fresh row,
+                # modulo the one-shot quantization error.
+                sample = nn.initializers.normal(stddev=0.05)(
+                    self.make_rng("params"), shape, jnp.float32
+                )
+                q8, scale = quantize_rows(sample)
+                return {"q8": q8, "scale": scale}
+
+            planes = self.variable("quantized", "embedding", _init_planes)
+            q8 = planes.value["q8"]
+            scale = planes.value["scale"]
+
+            def lookup(flat_rows):
+                deq = dequantize_rows(
+                    q8.at[flat_rows].get(mode=_PIB),
+                    scale.at[flat_rows].get(mode=_PIB),
+                )
+                return deq + _grad_tap(carrier, flat_rows)
+        else:
+            # Same initializer as the flat arena: a slot that is never
+            # admitted before first use behaves like a fresh flat-arena
+            # row.
+            table = self.param(
+                "embedding",
+                nn.initializers.normal(stddev=0.05),
+                shape,
+                self.param_dtype,
+            )
+
+            def lookup(flat_rows):
+                return _lookup(table, flat_rows)
+
         rows = jnp.asarray(slots)
         flat = rows.reshape(-1)
-        hot = _lookup(table, jnp.maximum(flat, 0)).reshape(
+        hot = lookup(jnp.maximum(flat, 0)).reshape(
             rows.shape + (self.output_dim,)
         )
         if overlay is None:

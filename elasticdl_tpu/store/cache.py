@@ -31,6 +31,81 @@ import numpy as np
 
 from elasticdl_tpu.data.wire import frequency_rank
 
+# Device cache storage modes (mirrors layers/arena.py ARENA_DTYPES —
+# not imported: this module must stay jax-free numpy).
+CACHE_DTYPES = ("float32", "int8")
+
+
+def cache_value_bytes_per_row(dim: int, cache_dtype: str) -> int:
+    """Bytes one cache row of one plane occupies on the fused GATHER
+    path: fp32 streams 4*dim; int8 streams dim code bytes + one fp32
+    scale.  (The fp32 carrier + Adam moments exist in BOTH modes and the
+    forward never reads the carrier's bytes — XLA folds the exact-zero
+    add away — so they cancel out of the comparison; docs/PERF.md §4.)"""
+    if cache_dtype == "int8":
+        return int(dim) * 1 + 4
+    return int(dim) * 4
+
+
+def device_cache_bytes(planes: Dict[str, int], cache_rows: int,
+                       cache_dtype: str) -> int:
+    """Analytic bytes of the gather-path cache storage across planes."""
+    return sum(
+        int(cache_rows) * cache_value_bytes_per_row(dim, cache_dtype)
+        for dim in planes.values()
+    )
+
+
+def device_cache_bytes_per_step(planes: Dict[str, int], lookups: int,
+                                cache_dtype: str) -> int:
+    """Analytic gather-path bytes one train step streams from the cache:
+    `lookups` row reads per plane (B*F for the dense slot batch, or the
+    dedup'd unique count on the packed wire)."""
+    return sum(
+        int(lookups) * cache_value_bytes_per_row(dim, cache_dtype)
+        for dim in planes.values()
+    )
+
+
+def partition_plan(plan: "CachePlan", num_shards: int,
+                   cache_rows: int) -> list:
+    """Split one admission plan into per-device sub-plans along the
+    mesh-sharded slot arena.
+
+    `embedding_param_sharding` row-shards the (cache_rows, dim) cache
+    table over the mesh `model` axis in contiguous blocks of
+    cache_rows/num_shards rows, so the device owning a slot is simply
+    `slot // block`.  Each sub-plan keeps the parent plan's admission
+    order within its device (order-preserving mask selection) and the
+    union of the sub-plans is exactly the parent plan — the equivalence
+    the sharded-seam test pins.  The scatter itself still executes as
+    ONE fused program (XLA partitions it from the table sharding); the
+    sub-plans are the per-chip accounting the bench and metrics report.
+    """
+    num_shards = int(num_shards)
+    if num_shards < 1 or cache_rows % num_shards:
+        raise ValueError(
+            f"cache_rows={cache_rows} must divide evenly over "
+            f"{num_shards} mesh shards (row-sharded table blocks)"
+        )
+    block = cache_rows // num_shards
+    subs = []
+    admit_dev = np.asarray(plan.admit_slots, np.int64) // block
+    evict_dev = np.asarray(plan.evict_slots, np.int64) // block
+    for d in range(num_shards):
+        am = admit_dev == d
+        em = evict_dev == d
+        subs.append({
+            "device": d,
+            "slot_lo": d * block,
+            "slot_hi": (d + 1) * block,
+            "admit_slots": plan.admit_slots[am].copy(),
+            "admit_rows": plan.admit_rows[am].copy(),
+            "evict_slots": plan.evict_slots[em].copy(),
+            "evict_rows": plan.evict_rows[em].copy(),
+        })
+    return subs
+
 
 @dataclass
 class CachePlan:
@@ -55,6 +130,12 @@ class CachePlan:
     prefetch_rows: Optional[np.ndarray] = None  # admit_rows[~deferred]
     admit_values: Dict[str, np.ndarray] = field(default_factory=dict)
     ready: threading.Event = field(default_factory=threading.Event)
+    # Mesh-sharded seam: per-device sub-plans over the row-sharded slot
+    # arena (partition_plan); None on an unsharded (1-device) store.
+    sub_plans: Optional[list] = None
+    # Fused multi-step: number of batches this plan's admissions cover
+    # (1 for per-batch plans, K for a steps_per_execution block).
+    block_batches: int = 1
 
 
 class HotRowCache:
@@ -65,10 +146,19 @@ class HotRowCache:
     stateful).
     """
 
-    def __init__(self, capacity: int, decay: float = 0.999):
+    def __init__(self, capacity: int, decay: float = 0.999,
+                 dtype: str = "float32"):
         if capacity < 1:
             raise ValueError("cache needs at least one row")
+        if dtype not in CACHE_DTYPES:
+            raise ValueError(
+                f"cache dtype must be one of {CACHE_DTYPES}, got {dtype!r}"
+            )
         self.capacity = int(capacity)
+        # Storage dtype of the device VALUES this bookkeeping fronts —
+        # carried through state_arrays() so a sidecar written by an int8
+        # cache can never be silently re-interpreted as fp32 on restore.
+        self.dtype = dtype
         self._decay = float(decay)
         self._slot_of: Dict[int, int] = {}      # store row -> slot
         self.row_of = np.full(self.capacity, -1, np.int64)
@@ -106,7 +196,9 @@ class HotRowCache:
             raise ValueError(
                 f"batch touches {uniq.size} unique rows but the cache "
                 f"holds {self.capacity}; shrink the batch or grow the "
-                f"cache — thrashing within one step is not supported"
+                f"cache — thrashing within one step is not supported "
+                "(with steps_per_execution > 1 the admission block spans "
+                "the UNION of all K fused batches' rows)"
             )
         resident = np.fromiter(
             (int(r) in self._slot_of for r in uniq), bool, uniq.size
@@ -189,11 +281,29 @@ class HotRowCache:
     # ---- serialization -------------------------------------------------
 
     def state_arrays(self):
-        """(row_of, score) — enough to rebuild residency after restore."""
-        return self.row_of.copy(), self._score.copy()
+        """(row_of, score, dtype) — residency map plus the PLANE DTYPE
+        of the device values this map fronts.  The dtype travels with
+        the sidecar so an int8 cache's values can never restore into an
+        fp32 cache (or vice versa) without an explicit conversion."""
+        return self.row_of.copy(), self._score.copy(), self.dtype
 
     def load_state_arrays(self, row_of: np.ndarray,
-                          score: Optional[np.ndarray] = None) -> None:
+                          score: Optional[np.ndarray] = None,
+                          dtype: Optional[str] = None,
+                          convert: bool = False) -> None:
+        """Adopt a saved residency map.  `dtype` is the saved cache's
+        plane dtype (state_arrays()[2] / the sidecar's `cache_dtype`
+        meta); a mismatch with this cache's dtype raises unless
+        `convert=True` — the caller asserting the device VALUES were
+        converted too (CheckpointSaver's arena_convert restore path)."""
+        if dtype is not None and dtype != self.dtype and not convert:
+            raise ValueError(
+                f"cache plane dtype mismatch: sidecar holds {dtype!r} "
+                f"values but this cache stores {self.dtype!r} — restore "
+                "through CheckpointSaver (arena_convert migrates the "
+                "device values) or pass convert=True after converting "
+                "them yourself"
+            )
         row_of = np.asarray(row_of, np.int64)
         if row_of.shape != (self.capacity,):
             raise ValueError(

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict
 
 import numpy as np
@@ -67,12 +67,20 @@ def save_sidecar(checkpoint_dir: str, step: int, store, state) -> str:
     arrays: Dict[str, np.ndarray] = {}
     for key, value in store.host.state_dict().items():
         arrays[f"host__{key}"] = value
-    row_of, score = store.cache.state_arrays()
+    row_of, score, cache_dtype = store.cache.state_arrays()
     arrays["cache__row_of"] = row_of
     arrays["cache__score"] = score
-    for name, table in store_device.read_full_tables(
-            state, store.param_paths).items():
-        arrays[f"values__{name}"] = table
+    if cache_dtype == "int8":
+        # Raw q8/scale planes, NOT a dequantized fp32 view: an
+        # int8 -> int8 restore must be bit-exact, no requant round trip.
+        for name, planes in store_device.read_full_planes(
+                state, store.param_paths).items():
+            arrays[f"values__{name}__q8"] = planes["q8"]
+            arrays[f"values__{name}__scale"] = planes["scale"]
+    else:
+        for name, table in store_device.read_full_tables(
+                state, store.param_paths).items():
+            arrays[f"values__{name}"] = table
 
     npz_path = os.path.join(d, "store.npz")
     tmp = npz_path + ".tmp"
@@ -89,6 +97,7 @@ def save_sidecar(checkpoint_dir: str, step: int, store, state) -> str:
         "host_dtype": store.host.host_dtype,
         "planes": {name: int(dim) for name, dim in store.planes.items()},
         "vocab_rows": int(store.host.size),
+        "cache_dtype": cache_dtype,
     }
     meta_path = os.path.join(d, "meta.json")
     tmp = meta_path + ".tmp"
@@ -108,7 +117,18 @@ class TieredSidecar:
     host_state: Dict[str, np.ndarray]
     row_of: np.ndarray                 # (cache_rows,) store row per slot
     score: np.ndarray
-    cache_values: Dict[str, np.ndarray]   # plane -> (cache_rows, dim)
+    cache_values: Dict[str, np.ndarray]   # plane -> (cache_rows, dim) fp32
+    # int8 sidecars additionally carry the raw planes (bit-exact
+    # int8 -> int8 restore); cache_values is then the dequantized view.
+    cache_planes: Dict[str, Dict[str, np.ndarray]] = field(
+        default_factory=dict
+    )
+
+    @property
+    def cache_dtype(self) -> str:
+        """Plane dtype the cache VALUES were saved as.  Pre-ISSUE-18
+        sidecars carry no marker and were always fp32."""
+        return self.meta.get("cache_dtype", "float32")
 
     def host_plane(self, name: str) -> np.ndarray:
         """Full (vocab_rows, dim) fp32 view of a host plane."""
@@ -147,6 +167,7 @@ def load_sidecar(checkpoint_dir: str, step: int) -> TieredSidecar:
     host_state: Dict[str, np.ndarray] = {}
     row_of = score = None
     cache_values: Dict[str, np.ndarray] = {}
+    cache_planes: Dict[str, Dict[str, np.ndarray]] = {}
     with np.load(os.path.join(d, "store.npz")) as npz:
         for key in npz.files:
             if key.startswith("host__"):
@@ -156,8 +177,25 @@ def load_sidecar(checkpoint_dir: str, step: int) -> TieredSidecar:
             elif key == "cache__score":
                 score = npz[key]
             elif key.startswith("values__"):
-                cache_values[key[len("values__"):]] = npz[key]
-    return TieredSidecar(meta, host_state, row_of, score, cache_values)
+                name = key[len("values__"):]
+                for plane_key in ("q8", "scale"):
+                    suffix = f"__{plane_key}"
+                    if name.endswith(suffix):
+                        base = name[: -len(suffix)]
+                        cache_planes.setdefault(base, {})[plane_key] = (
+                            npz[key]
+                        )
+                        break
+                else:
+                    cache_values[name] = npz[key]
+    # int8 layout: materialise the fp32 view consumers (serving,
+    # migration) read through; the raw planes stay alongside.
+    for name, planes in cache_planes.items():
+        cache_values[name] = dequantize_rows_host(
+            planes["q8"], planes["scale"]
+        )
+    return TieredSidecar(meta, host_state, row_of, score, cache_values,
+                         cache_planes)
 
 
 SHARDED_ROOT = ".sharded"

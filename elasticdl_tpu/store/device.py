@@ -20,9 +20,19 @@ the unpadded scatter's.
 
 On top of the padding, the per-plane gathers/scatters are FUSED into
 one jitted program per call site (cache keyed on the static plane
-layout; jax's own jit cache handles the bucket shapes).  The eager
-version of apply_admissions cost ~6 separate dispatches per step —
-fusing them cut apply time ~5x on the tier-1 box.
+layout + cache dtype; jax's own jit cache handles the bucket shapes).
+The eager version of apply_admissions cost ~6 separate dispatches per
+step — fusing them cut apply time ~5x on the tier-1 box.
+
+`cache_dtype="int8"` (ISSUE 18): the cache VALUES live as q8 codes +
+per-row fp32 scales in model_state["quantized"] (PR 9's plane layout,
+`layers/arena.py TieredArena`), with the trainable param a zero fp32
+carrier.  Reads dequantize inside the fused gather (+ the carrier, so a
+mid-step read stays exact); admissions quantize the host-gathered fp32
+values inside the fused scatter and zero the carrier rows alongside the
+moments.  The quantize/dequantize numerics are `layers/arena.py`'s
+functions; this module is on GL-QUANT's named store allowlist because
+it must address the raw planes to scatter/gather them.
 
 Reads return OWNING numpy copies (`np.array(..., copy=True)`): the
 train step donates its state (`donate_argnums=(0,)`), so a zero-copy
@@ -39,6 +49,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from elasticdl_tpu.layers.arena import dequantize_rows, quantize_rows
 from elasticdl_tpu.worker.trainer import run_device_serialized
 
 
@@ -56,6 +67,14 @@ def _set_in(tree, path: Tuple[str, ...], value):
     out = dict(tree)
     out[path[0]] = _set_in(tree[path[0]], path[1:], value)
     return out
+
+
+def _quant_path(path: Tuple[str, ...]) -> Tuple[str, ...]:
+    """Plane path inside model_state["quantized"] for a cache param path
+    ("params", <module>, "embedding") — the collections mirror each
+    other by construction (flax puts the `self.variable("quantized",
+    "embedding", ...)` planes at the param's module path)."""
+    return path[1:]
 
 
 def _pad_bucket(n: int) -> int:
@@ -85,27 +104,64 @@ def _layout(param_paths: Dict[str, Tuple[str, ...]]
 
 
 @functools.lru_cache(maxsize=None)
-def _gather_program(layout):
+def _gather_program(layout, cache_dtype: str):
     paths = tuple(path for _, path in layout)
 
+    if cache_dtype == "int8":
+
+        @jax.jit
+        def gather(params, quant, idx):
+            # dequant(codes, scales) + carrier: exact even mid-step (the
+            # carrier is zero BETWEEN steps — fold_quantized_updates —
+            # so this is normally just the dequantized planes).
+            out = []
+            for path in paths:
+                planes = _get_in(quant, _quant_path(path))
+                carrier = _get_in(params, path)
+                out.append(
+                    dequantize_rows(
+                        planes["q8"][idx], planes["scale"][idx]
+                    ) + carrier[idx]
+                )
+            return tuple(out)
+
+        return gather
+
     @jax.jit
-    def gather(params, idx):
+    def gather(params, quant, idx):
+        del quant
         return tuple(_get_in(params, path)[idx] for path in paths)
 
     return gather
 
 
+def _quant_collection(state, cache_dtype: str):
+    if cache_dtype != "int8":
+        return {}
+    quant = state.model_state.get("quantized")
+    if not quant:
+        raise ValueError(
+            'cache_dtype="int8" but the model state has no "quantized" '
+            "collection — build the zoo model with cache_dtype='int8' "
+            "(TieredArena) so the planes exist"
+        )
+    return quant
+
+
 def read_rows(state, param_paths: Dict[str, Tuple[str, ...]],
-              slots: np.ndarray) -> Dict[str, np.ndarray]:
+              slots: np.ndarray,
+              cache_dtype: str = "float32") -> Dict[str, np.ndarray]:
     """Owning fp32 copies of cache rows `slots`, per plane — the
-    eviction write-back read."""
+    eviction write-back read.  int8 caches dequantize inside the fused
+    gather; the returned values are always fp32."""
     n = int(np.asarray(slots).size)
     idx = _pad_indices(np.asarray(slots, np.int32))
     layout = _layout(param_paths)
-    gather = _gather_program(layout)
+    gather = _gather_program(layout, cache_dtype)
+    quant = _quant_collection(state, cache_dtype)
 
     def _read():
-        rows = gather(state.params, idx)
+        rows = gather(state.params, quant, idx)
         return {
             name: np.array(jax.device_get(t), np.float32, copy=True)[:n]
             for (name, _), t in zip(layout, rows)
@@ -115,12 +171,25 @@ def read_rows(state, param_paths: Dict[str, Tuple[str, ...]],
 
 
 def read_full_tables(state, param_paths: Dict[str, Tuple[str, ...]],
+                     cache_dtype: str = "float32"
                      ) -> Dict[str, np.ndarray]:
     """Owning fp32 copies of the whole cache table per plane (sidecar
-    checkpointing — cache tables are small by construction)."""
+    checkpointing, migration — cache tables are small by construction).
+    int8 caches return the dequantized view (+ carrier, exact)."""
 
     def _read():
         out = {}
+        if cache_dtype == "int8":
+            quant = _quant_collection(state, cache_dtype)
+            for name, path in param_paths.items():
+                planes = _get_in(quant, _quant_path(path))
+                table = dequantize_rows(
+                    planes["q8"], planes["scale"]
+                ) + _get_in(state.params, path)
+                out[name] = np.array(
+                    jax.device_get(table), np.float32, copy=True
+                )
+            return out
         for name, path in param_paths.items():
             table = _get_in(state.params, path)
             out[name] = np.array(
@@ -131,13 +200,38 @@ def read_full_tables(state, param_paths: Dict[str, Tuple[str, ...]],
     return run_device_serialized(_read)
 
 
+def read_full_planes(state, param_paths: Dict[str, Tuple[str, ...]]
+                     ) -> Dict[str, Dict[str, np.ndarray]]:
+    """Owning RAW plane copies {name: {"q8", "scale"}} of an int8 cache
+    — the sidecar stores these verbatim so an int8->int8 restore is
+    bit-exact (no dequant/requant round trip)."""
+    quant = _quant_collection(state, "int8")
+
+    def _read():
+        out = {}
+        for name, path in param_paths.items():
+            planes = _get_in(quant, _quant_path(path))
+            out[name] = {
+                "q8": np.array(
+                    jax.device_get(planes["q8"]), np.int8, copy=True
+                ),
+                "scale": np.array(
+                    jax.device_get(planes["scale"]), np.float32, copy=True
+                ),
+            }
+        return out
+
+    return run_device_serialized(_read)
+
+
 def zero_cache_slots(state, param_paths: Dict[str, Tuple[str, ...]],
-                     slots: np.ndarray):
+                     slots: np.ndarray, cache_dtype: str = "float32"):
     """Zero cache rows `slots` in every plane (and their optimizer
     moments) — the device half of shard-handoff invalidation: a moved
     shard's old slots must not keep serving stale values on the worker
     that lost the shard.  Reuses the fused admission program with
-    all-zero row values."""
+    all-zero row values (an int8 cache quantizes zeros to code 0 /
+    scale 1.0 — the exact all-zero-row representation)."""
     slots = np.asarray(slots, np.int32).reshape(-1)
     if slots.size == 0:
         return state
@@ -148,19 +242,27 @@ def zero_cache_slots(state, param_paths: Dict[str, Tuple[str, ...]],
         )
         for name, path in param_paths.items()
     }
-    return apply_admissions(state, param_paths, slots, values)
+    return apply_admissions(state, param_paths, slots, values,
+                            cache_dtype=cache_dtype)
 
 
 def apply_admissions(state, param_paths: Dict[str, Tuple[str, ...]],
                      slots: np.ndarray,
-                     values: Dict[str, np.ndarray]):
-    """Scatter host-gathered row values into every plane's cache param
+                     values: Dict[str, np.ndarray],
+                     cache_dtype: str = "float32"):
+    """Scatter host-gathered row values into every plane's cache storage
     and zero those rows' optimizer moments.
 
     Moment zeroing makes an admitted row behave exactly like a
     never-touched flat-arena row: in Adam, an untouched row's mu/nu stay
     zero, so a row that leaves and re-enters the cache must not carry
     moments from its previous residency.
+
+    int8 caches quantize the fp32 values INSIDE the fused program
+    (layers/arena.py `quantize_rows` — deterministic round-to-nearest,
+    the same numerics admissions from an int8 HOST tier already went
+    through) and additionally zero the admitted rows of the fp32
+    carrier: a re-admitted slot must not inherit a stale carrier delta.
     """
     n = int(np.asarray(slots).size)
     idx = _pad_indices(np.asarray(slots, np.int32))
@@ -177,26 +279,52 @@ def apply_admissions(state, param_paths: Dict[str, Tuple[str, ...]],
         _pad_values(np.asarray(values[name], np.float32))
         for name, _ in layout
     )
-    admit = _admit_program(layout)
+    admit = _admit_program(layout, cache_dtype)
+    quant = _quant_collection(state, cache_dtype)
 
     def _apply():
-        params, opt_state = admit(state.params, state.opt_state, idx, vals)
+        params, new_quant, opt_state = admit(
+            state.params, quant, state.opt_state, idx, vals
+        )
+        if cache_dtype == "int8":
+            model_state = dict(state.model_state)
+            model_state["quantized"] = new_quant
+            return state.replace(
+                params=params, opt_state=opt_state,
+                model_state=model_state,
+            )
         return state.replace(params=params, opt_state=opt_state)
 
     return run_device_serialized(_apply)
 
 
 @functools.lru_cache(maxsize=None)
-def _admit_program(layout):
+def _admit_program(layout, cache_dtype: str):
     paths = tuple(path for _, path in layout)
 
     @jax.jit
-    def admit(params, opt_state, idx, vals):
+    def admit(params, quant, opt_state, idx, vals):
         for path, v in zip(paths, vals):
-            table = _get_in(params, path)
-            params = _set_in(
-                params, path, table.at[idx].set(v.astype(table.dtype))
-            )
+            if cache_dtype == "int8":
+                planes = _get_in(quant, _quant_path(path))
+                codes, scales = quantize_rows(v)
+                planes = {
+                    "q8": planes["q8"].at[idx].set(codes),
+                    "scale": planes["scale"].at[idx].set(scales),
+                }
+                quant = _set_in(quant, _quant_path(path), planes)
+                # the carrier rows reset with the value: an admission IS
+                # the row's new fp32 state, any queued delta is stale
+                carrier = _get_in(params, path)
+                params = _set_in(
+                    params, path,
+                    carrier.at[idx].set(jnp.zeros((), carrier.dtype)),
+                )
+            else:
+                table = _get_in(params, path)
+                params = _set_in(
+                    params, path, table.at[idx].set(v.astype(table.dtype))
+                )
 
         # Optax moment trees share the params' pytree structure
         # (trainer.state_sharding uses the same trick); zero the admitted
@@ -224,6 +352,6 @@ def _admit_program(layout):
         opt_state = jax.tree.map(
             zero_rows, opt_state, is_leaf=is_param_like
         )
-        return params, opt_state
+        return params, quant, opt_state
 
     return admit

@@ -68,6 +68,25 @@ class TieredServingEngine:
             )
         sidecar = store_ckpt.load_sidecar(self._dir, step)
         meta = sidecar.meta
+        # Plane-dtype consistency: an int8-cache sidecar only pairs with
+        # a model compiled with quantized cache planes (and vice versa).
+        # Catch the mismatch HERE, atomically with the swap, instead of
+        # serving garbage through a silent reinterpretation.
+        template = getattr(self._engine, "state_template", None)
+        model_state = getattr(template, "model_state", None)
+        wants_int8 = bool(
+            isinstance(model_state, dict) and model_state.get("quantized")
+        )
+        if template is not None and (
+                (sidecar.cache_dtype == "int8") != wants_int8):
+            raise RuntimeError(
+                f"tiered sidecar at step {step} holds "
+                f"{sidecar.cache_dtype!r} cache values but the serving "
+                f"model was compiled with cache_dtype="
+                f"{'int8' if wants_int8 else 'float32'!r}; rebuild the "
+                "serving model with the matching cache_dtype or migrate "
+                "the checkpoint (arena_convert)"
+            )
         vocab = LazyVocabulary.from_arrays(
             int(meta["num_fields"]), *sidecar.vocab_arrays()
         )

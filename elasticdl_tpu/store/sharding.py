@@ -422,7 +422,7 @@ class ShardedTieredStore:
         out: Dict[str, np.ndarray] = {}
         with self._lock:
             for shard, cache in self._caches.items():
-                row_of, score = cache.state_arrays()
+                row_of, score, _ = cache.state_arrays()
                 out[f"shard{shard}__row_of"] = row_of
                 out[f"shard{shard}__score"] = score
         return out

@@ -54,7 +54,13 @@ from elasticdl_tpu.common import events
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.common.metrics import MetricsRegistry
 from elasticdl_tpu.store import device as store_device
-from elasticdl_tpu.store.cache import CachePlan, HotRowCache
+from elasticdl_tpu.store.cache import (
+    CACHE_DTYPES,
+    CachePlan,
+    HotRowCache,
+    device_cache_bytes,
+    partition_plan,
+)
 from elasticdl_tpu.store.host_tier import HostTier
 
 logger = get_logger(__name__)
@@ -70,12 +76,23 @@ class TieredStore:
                  seed: int = 0x5EED,
                  param_paths: Optional[Dict[str, Tuple[str, ...]]] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 phase_timer=None):
+                 phase_timer=None, cache_dtype: str = "float32"):
+        if cache_dtype not in CACHE_DTYPES:
+            raise ValueError(
+                f"cache_dtype must be one of {CACHE_DTYPES}, "
+                f"got {cache_dtype!r}"
+            )
         self.planes = dict(planes)
         self.num_fields = int(num_fields)
         self.cache_rows = int(cache_rows)
+        self.cache_dtype = cache_dtype
+        # Mesh-sharded seam (ISSUE 18b): >1 means the cache slot arena is
+        # row-sharded over the model axis and every plan carries per-chip
+        # sub-plans (accounting + tests; execution stays ONE fused
+        # program — XLA partitions it from the table sharding).
+        self.mesh_shards = 1
         self.host = HostTier(planes, num_fields, host_dtype, seed)
-        self.cache = HotRowCache(cache_rows)
+        self.cache = HotRowCache(cache_rows, dtype=cache_dtype)
         self.param_paths = dict(param_paths) if param_paths else {
             name: ("params", name, "embedding") for name in planes
         }
@@ -128,6 +145,42 @@ class TieredStore:
             self._hit_ratio,
             "Lifetime cache hit fraction of embedding lookups",
         )
+        self._block_plans = self.registry.counter(
+            "store_block_plans_total",
+            "Multi-batch admission plans spanning a fused step block",
+        )
+        self.registry.gauge_fn(
+            "store_device_cache_bytes",
+            lambda: float(self.device_cache_bytes()),
+            "Resident byte footprint of the device hot-row cache values",
+        )
+        self.registry.gauge_fn(
+            "store_mesh_shards_count",
+            lambda: float(self.mesh_shards),
+            "Model-axis shards the cache slot arena is partitioned over",
+        )
+
+    def device_cache_bytes(self) -> int:
+        """Analytic VALUE bytes of the device cache at full capacity —
+        q8 codes + per-row scales for int8, 4 bytes/element for fp32.
+        The fp32 carrier and optimizer moments are identical in both
+        modes and excluded (store/cache.py cache_value_bytes_per_row)."""
+        return device_cache_bytes(
+            self.planes, self.cache_rows, self.cache_dtype
+        )
+
+    def set_mesh_shards(self, n: int) -> None:
+        """Declare the model-axis mesh size the cache params are sharded
+        over.  cache_rows must split evenly so every chip owns an equal
+        contiguous slot block (same contiguous row-blocking jax uses for
+        a P(\"model\", None) table)."""
+        n = int(n)
+        if n < 1 or self.cache_rows % n:
+            raise ValueError(
+                f"cache_rows={self.cache_rows} must divide evenly over "
+                f"{n} mesh shards"
+            )
+        self.mesh_shards = n
 
     def _hit_ratio(self) -> float:
         hits = self._hits.value()
@@ -245,15 +298,66 @@ class TieredStore:
                 order = np.lexsort((rows_u, -counts_u))
                 ranked = (rows_u[order], counts_u[order])
             plan = self.cache.plan(rows, ranked=ranked)
-            plan.growth = n_new
-            for r in plan.evict_rows:
-                self._pending_writeback.add(int(r))
-            plan.deferred = np.fromiter(
-                (int(r) in self._pending_writeback
-                 for r in plan.admit_rows),
-                bool, plan.admit_rows.size,
+            self._finish_plan_locked(plan, n_new)
+        self._publish_plan(plan, n_new)
+        return plan.slots, plan
+
+    def prepare_block(self, sparse_list):
+        """Plan ONE admission block covering the UNION of K batches'
+        rows (steps_per_execution > 1, ISSUE 18c): the K fused steps
+        run as one uninterruptible lax.scan, so per-batch plans are
+        impossible (plan k+1 could evict rows batch k still needs,
+        with no apply point between them).  Union planning makes every
+        row of every batch resident for the whole block; evictions are
+        rows OUTSIDE the union, so reading them before the block is
+        exact.  Frequency ranking is recomputed over the union (a
+        per-batch wire ranking doesn't aggregate across batches).
+
+        Returns (slots_list, plan): K slot arrays, one plan whose
+        admit/evict apply once before the block.  Same single-thread
+        batch-order contract as prepare()."""
+        if not sparse_list:
+            raise ValueError("prepare_block needs at least one batch")
+        with self._lock:
+            rows_list = []
+            n_new = 0
+            for sparse in sparse_list:
+                rows, grown = self.host.assign(sparse)
+                rows_list.append(np.asarray(rows))
+                n_new += grown
+            union = np.concatenate([r.reshape(-1) for r in rows_list])
+            plan = self.cache.plan(union)
+            plan.block_batches = len(rows_list)
+            self._finish_plan_locked(plan, n_new)
+        self._publish_plan(plan, n_new)
+        self._block_plans.inc()
+        flat_slots = np.asarray(plan.slots).reshape(-1)
+        slots_list = []
+        offset = 0
+        for rows in rows_list:
+            size = rows.size
+            slots_list.append(
+                flat_slots[offset:offset + size].reshape(rows.shape)
             )
-            plan.prefetch_rows = plan.admit_rows[~plan.deferred]
+            offset += size
+        return slots_list, plan
+
+    def _finish_plan_locked(self, plan: CachePlan, n_new: int) -> None:
+        plan.growth = n_new
+        for r in plan.evict_rows:
+            self._pending_writeback.add(int(r))
+        plan.deferred = np.fromiter(
+            (int(r) in self._pending_writeback
+             for r in plan.admit_rows),
+            bool, plan.admit_rows.size,
+        )
+        plan.prefetch_rows = plan.admit_rows[~plan.deferred]
+        if self.mesh_shards > 1:
+            plan.sub_plans = partition_plan(
+                plan, self.mesh_shards, self.cache_rows
+            )
+
+    def _publish_plan(self, plan: CachePlan, n_new: int) -> None:
         self._hits.inc(plan.hits)
         self._misses.inc(plan.misses)
         if n_new:
@@ -271,7 +375,6 @@ class TieredStore:
             # prefetcher thread buys no overlap and would miscount the
             # wait as async; the sync gather is the honest attribution.
             plan.ready.set()
-        return plan.slots, plan
 
     # ---- consumer side -------------------------------------------------
 
@@ -280,7 +383,8 @@ class TieredStore:
         consumes `plan.slots`.  Returns the updated state."""
         if plan.evict_rows.size:
             evicted = store_device.read_rows(
-                state, self.param_paths, plan.evict_slots
+                state, self.param_paths, plan.evict_slots,
+                cache_dtype=self.cache_dtype,
             )
             self._fold_q.put((plan.evict_rows.copy(), evicted))
             if not self._started:
@@ -316,7 +420,8 @@ class TieredStore:
                     full[name] = arr
                 values = full
             state = store_device.apply_admissions(
-                state, self.param_paths, plan.admit_slots, values
+                state, self.param_paths, plan.admit_slots, values,
+                cache_dtype=self.cache_dtype,
             )
         return state
 
@@ -396,13 +501,21 @@ class TieredStore:
 
     def load_sidecar_state(self, host_state: Dict[str, np.ndarray],
                            row_of: np.ndarray,
-                           score: Optional[np.ndarray] = None) -> None:
+                           score: Optional[np.ndarray] = None,
+                           cache_dtype: Optional[str] = None,
+                           convert: bool = False) -> None:
         """Adopt a restored sidecar: host planes + vocab + cache map.
         Cache VALUES live in the restored TrainState (orbax), so only
-        bookkeeping changes here."""
+        bookkeeping changes here.  `cache_dtype` is the sidecar's
+        recorded plane dtype (None for pre-ISSUE-18 sidecars = fp32);
+        a mismatch against this store's dtype raises unless `convert`
+        acknowledges the values were migrated (CheckpointSaver's
+        arena_convert path)."""
         with self._lock:
             self.host.load_state_dict(host_state)
-            self.cache.load_state_arrays(row_of, score)
+            self.cache.load_state_arrays(
+                row_of, score, dtype=cache_dtype, convert=convert
+            )
             self._pending_writeback.clear()
 
     # ---- introspection -------------------------------------------------
@@ -419,6 +532,10 @@ class TieredStore:
             "vocab_rows": self.host.size,
             "cache_occupancy_rows": self.cache.occupancy,
             "cache_rows": self.cache_rows,
+            "cache_dtype": self.cache_dtype,
+            "device_cache_bytes": self.device_cache_bytes(),
+            "mesh_shards": self.mesh_shards,
+            "block_plans": int(self._block_plans.value()),
             "host_bytes": self.host.nbytes,
             "prefetch_ticks": self.prefetch_ticks,
             "fold_ticks": self.fold_ticks,

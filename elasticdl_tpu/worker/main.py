@@ -172,6 +172,7 @@ def _main(argv=None):
             args, "prediction_outputs_processor", ""
         ),
         arena_dtype=getattr(args, "arena_dtype", ""),
+        store_cache_dtype=getattr(args, "store_cache_dtype", ""),
     )
     if spec.custom_data_reader is not None:
         reader = spec.custom_data_reader(data_origin=args.training_data)

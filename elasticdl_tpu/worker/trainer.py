@@ -444,6 +444,43 @@ class Trainer:
         from elasticdl_tpu.data.wire import is_packed_dedup
 
         mesh_lib.set_current_mesh(self.mesh)
+
+        # Tiered store under steps_per_execution > 1 (ISSUE 18c): the K
+        # steps run as ONE uninterruptible scan, so admissions are
+        # planned once over the UNION of all K batches' rows and applied
+        # before the block — every step sees its rows resident, folds
+        # land once per block.  Eager per-batch plans are rejected: plan
+        # k+1's evictions could reuse a slot batch k still reads, with
+        # no apply point between the fused steps (client/api.py forces
+        # deferred planning for this reason).
+        if any("__store_plan__" in b for b in batches):
+            raise ValueError(
+                "eager per-batch store plans cannot cover a fused "
+                "multi-step block — use TieredStore.enable_deferred_"
+                "prepare() so the raw sparse batches arrive here and "
+                "one union plan covers the whole block"
+            )
+        if any("__store_sparse__" in b for b in batches):
+            pendings = [b.get("__store_sparse__") for b in batches]
+            batches = [
+                {k: v for k, v in b.items() if k != "__store_sparse__"}
+                for b in batches
+            ]
+            if self.tiered_store is not None:
+                if any(p is None for p in pendings):
+                    raise ValueError(
+                        "mixed store-prepared and raw batches in one "
+                        "fused block"
+                    )
+                slots_list, plan = self.tiered_store.prepare_block(
+                    [sparse for sparse, _ranked in pendings]
+                )
+                for b, slots in zip(batches, slots_list):
+                    features = dict(b["features"])
+                    features["slots"] = slots
+                    b["features"] = features
+                state = self.tiered_store.apply_plan(state, plan)
+
         stacked = self._timed(
             "pack",
             lambda: jax.tree.map(lambda *xs: np.stack(xs), *batches),

@@ -68,9 +68,23 @@ def _same_batch_shapes(a, b) -> bool:
     """True when two host batches have identical leaf shapes/dtypes —
     the np.stack compatibility the K-step scan program requires.  Only
     the dedup wire format ever produces ragged consecutive batches
-    (sticky pad-cap growth, data/wire.py DedupPacker)."""
+    (sticky pad-cap growth, data/wire.py DedupPacker).  Store
+    bookkeeping keys are host-side riders the stacked path strips before
+    stacking (trainer.train_on_batch_stack plans the block from them) —
+    their ragged ranked tuples must not veto an otherwise stackable
+    pair."""
     import jax
 
+    def _strip(batch):
+        if isinstance(batch, dict) and any(
+                k.startswith("__store_") for k in batch):
+            return {
+                k: v for k, v in batch.items()
+                if not k.startswith("__store_")
+            }
+        return batch
+
+    a, b = _strip(a), _strip(b)
     la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
     return len(la) == len(lb) and all(
         np.shape(x) == np.shape(y)

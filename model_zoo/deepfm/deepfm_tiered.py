@@ -38,6 +38,12 @@ from model_zoo.deepfm.deepfm_functional_api import (  # noqa: F401
     feed_bulk as _base_feed_bulk,
     loss,
     optimizer,
+    # Mesh-sharded seam (ISSUE 18b): the hot-row cache tables row-shard
+    # over the mesh `model` axis exactly like the flat arena tables —
+    # re-exporting the flat zoo's rule is all it takes (model_handler
+    # picks `param_sharding` up by name; the "embedding" path match
+    # covers the cache params AND the quantized planes).
+    param_sharding,
 )
 
 # Set by custom_model(); read by build_tiered_store().  The feeds get no
@@ -47,6 +53,7 @@ from model_zoo.deepfm.deepfm_functional_api import (  # noqa: F401
 CACHE_ROWS = 1 << 12
 EMBED_DIM = 16
 HOST_DTYPE = "fp32"
+CACHE_DTYPE = "float32"
 STORE_SEED = 0x5EED
 
 # The store the Local runner built last — regression tests reach in here
@@ -86,17 +93,20 @@ class TieredDeepFM(nn.Module):
     embed_dim: int = 16
     mlp_dims: tuple = (256, 128)
     compute_dtype: jnp.dtype = jnp.float32
+    cache_dtype: str = "float32"
 
     @nn.compact
     def __call__(self, features):
         slots = features["slots"]
         # second-order / deep embeddings: (B, 26, k)
         emb = TieredArena(
-            self.cache_rows, self.embed_dim, name="fm_embedding"
+            self.cache_rows, self.embed_dim, name="fm_embedding",
+            cache_dtype=self.cache_dtype,
         )(slots, overlay=features.get("cold_fm"))
         # first-order weights: (B, 26, 1)
         first = TieredArena(
-            self.cache_rows, 1, name="fm_linear"
+            self.cache_rows, 1, name="fm_linear",
+            cache_dtype=self.cache_dtype,
         )(slots, overlay=features.get("cold_linear"))
         return deepfm_tail(
             emb, first, features["dense"], self.mlp_dims,
@@ -107,16 +117,19 @@ class TieredDeepFM(nn.Module):
 def custom_model(
     cache_rows: int = 1 << 12, embed_dim: int = 16, bf16: bool = False,
     host_dtype: str = "fp32", store_seed: int = 0x5EED,
+    cache_dtype: str = "float32",
 ):
-    global CACHE_ROWS, EMBED_DIM, HOST_DTYPE, STORE_SEED
+    global CACHE_ROWS, EMBED_DIM, HOST_DTYPE, CACHE_DTYPE, STORE_SEED
     CACHE_ROWS = int(cache_rows)
     EMBED_DIM = int(embed_dim)
     HOST_DTYPE = host_dtype
+    CACHE_DTYPE = cache_dtype
     STORE_SEED = int(store_seed)
     return TieredDeepFM(
         cache_rows=CACHE_ROWS,
         embed_dim=EMBED_DIM,
         compute_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        cache_dtype=CACHE_DTYPE,
     )
 
 
@@ -141,6 +154,7 @@ def build_tiered_store(registry=None, phase_timer=None) -> TieredStore:
         seed=STORE_SEED,
         registry=registry,
         phase_timer=phase_timer,
+        cache_dtype=CACHE_DTYPE,
     )
     _LAST_STORE = store
     return store

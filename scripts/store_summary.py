@@ -4,13 +4,18 @@ Runs the store's pure-numpy host side — LazyVocabulary growth +
 HotRowCache admission — over a deterministic zipfian id stream and
 prints one machine-readable line:
 
-    STORE_SUMMARY hit_rate=<r> growth_rows=<n>
+    STORE_SUMMARY hit_rate=<r> growth_rows=<n> cache_dtype=<d> \
+        device_cache_bytes=<b> int8_bytes_reduction=<x> \
+        per_chip_cache_bytes=<b/8>
 
 `scripts/run_tests.sh` emits it next to TIER1_SUMMARY so CI can watch
 cache efficacy drift without running the full bench
 (`python bench.py tiered`).  No jax, no devices: the whole check is
 host math, which is the point — a cache-policy regression shows up
-here in well under a second.
+here in well under a second.  The byte fields are the ISSUE-18 analytic
+model (store/cache.py cache_value_bytes_per_row): fp32 vs int8 device
+cache VALUE bytes at this config's capacity, and the per-chip share
+over the 8-device mesh the MULTICHIP harness drives.
 
 tests/test_tiered_store.py asserts on `zipfian_summary()` directly, so
 the printed numbers and the tested numbers cannot diverge.
@@ -72,10 +77,34 @@ def zipfian_summary(cache_rows: int = CACHE_ROWS, **stream_kw):
     return hits / max(hits + misses, 1), vocab.size
 
 
+# The byte model reports deepfm_tiered's default plane set at this
+# config's cache capacity (store_planes(): embedding dim 16 + linear 1).
+EMBED_DIM = 16
+MESH_SHARDS = 8
+
+
+def byte_summary(cache_rows: int = CACHE_ROWS,
+                 embed_dim: int = EMBED_DIM,
+                 mesh_shards: int = MESH_SHARDS):
+    """(fp32_bytes, int8_bytes, reduction, per_chip_int8_bytes) — the
+    analytic device-cache VALUE bytes both STORE_SUMMARY and the unit
+    test report (same single-source pattern as zipfian_summary)."""
+    from elasticdl_tpu.store.cache import device_cache_bytes
+
+    planes = {"fm_embedding": embed_dim, "fm_linear": 1}
+    fp32 = device_cache_bytes(planes, cache_rows, "float32")
+    int8 = device_cache_bytes(planes, cache_rows, "int8")
+    return fp32, int8, fp32 / int8, int8 // mesh_shards
+
+
 def main() -> int:
     hit_rate, growth_rows = zipfian_summary()
+    fp32, int8, reduction, per_chip = byte_summary()
     print(f"STORE_SUMMARY hit_rate={hit_rate:.4f} "
-          f"growth_rows={growth_rows}")
+          f"growth_rows={growth_rows} "
+          f"cache_dtype=float32 device_cache_bytes={fp32} "
+          f"int8_bytes_reduction={reduction:.2f} "
+          f"per_chip_cache_bytes={per_chip}")
     return 0
 
 

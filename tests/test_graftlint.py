@@ -597,6 +597,26 @@ def test_quant_allowlisted_token():
     assert not check_source(src, "elasticdl_tpu/worker/x.py", [rule])
 
 
+def test_quant_store_device_seam_is_exempt():
+    # ISSUE 18: the device gather/scatter seam addresses raw planes
+    # (slot indexing inside dequantize call arguments) — exempt by
+    # module, like the arena itself
+    src = "out = dequantize_rows(planes['q8'][idx], scales[idx]) + c\n"
+    assert "elasticdl_tpu/store/device.py" \
+        in rules_quant.STORE_ALLOWED_MODULES
+    assert not check_source(src, "elasticdl_tpu/store/device.py",
+                            [rules_quant.QuantRule()])
+
+
+def test_quant_other_store_modules_still_covered():
+    # the exemption is per-module, not for store/ wholesale: the same
+    # source in tiered.py (or any new store module) still fires
+    src = "out = dequantize_rows(planes['q8'][idx], scales[idx]) + c\n"
+    found = check_source(src, "elasticdl_tpu/store/tiered.py",
+                         [rules_quant.QuantRule()])
+    assert _ids(found) == ["GL-QUANT"]
+
+
 # ---- acceptance demos (ISSUE exit-1 criteria) ---------------------------
 
 

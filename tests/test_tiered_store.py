@@ -136,9 +136,10 @@ def test_cache_never_evicts_current_batch_rows():
 def test_cache_state_arrays_round_trip():
     cache = HotRowCache(4)
     cache.plan(np.array([7, 8], np.int64))
-    row_of, score = cache.state_arrays()
+    row_of, score, dtype = cache.state_arrays()
+    assert dtype == "float32"
     clone = HotRowCache(4)
-    clone.load_state_arrays(row_of, score)
+    clone.load_state_arrays(row_of, score, dtype=dtype)
     p = clone.plan(np.array([7, 8], np.int64))
     assert p.misses == 0 and p.hits == 2
 
@@ -839,3 +840,421 @@ def test_local_multiworker_run_uses_deferred_planning(tmp_path):
     assert stats["growth_rows"] > 0
     assert stats["hit_rate"] > 0.5
     assert stats["cold_gather_overlap_share"] == 0.0
+
+
+# ---- int8 device cache / mesh seam / fused blocks (ISSUE 18) -----------
+
+
+def _fake_state_int8(cache_rows=CACHE_ROWS, dim=DIM):
+    """TrainState shaped like an int8 TieredDeepFM: zero fp32 carriers
+    under "params", q8/scale planes under model_state["quantized"]."""
+    base = _fake_state(cache_rows, dim, fill=0.0)
+    quantized = {
+        "fm_embedding": {"embedding": {
+            "q8": jnp.zeros((cache_rows, dim), jnp.int8),
+            "scale": jnp.ones((cache_rows, 1), jnp.float32),
+        }},
+        "fm_linear": {"embedding": {
+            "q8": jnp.zeros((cache_rows, 1), jnp.int8),
+            "scale": jnp.ones((cache_rows, 1), jnp.float32),
+        }},
+    }
+    return base.replace(model_state={"quantized": quantized})
+
+
+def test_int8_admission_round_trip_within_half_scale():
+    """Admit fp32 rows into an int8 cache, read them back: per-element
+    error is bounded by half the row's quantization bin (scale/2 with
+    scale = max|row|/127), and the fp32 carrier rows stay zero."""
+    from elasticdl_tpu.store import device as store_device
+
+    state = _fake_state_int8()
+    paths = {"fm_embedding": ("params", "fm_embedding", "embedding"),
+             "fm_linear": ("params", "fm_linear", "embedding")}
+    slots = np.array([3, 7, 11, 19], np.int32)
+    rng = np.random.RandomState(0)
+    values = {
+        "fm_embedding": (rng.randn(4, DIM) * 3).astype(np.float32),
+        "fm_linear": (rng.randn(4, 1) * 3).astype(np.float32),
+    }
+    state = store_device.apply_admissions(
+        state, paths, slots, values, cache_dtype="int8"
+    )
+    got = store_device.read_rows(state, paths, slots, cache_dtype="int8")
+    for name in paths:
+        scale = np.abs(values[name]).max(axis=1, keepdims=True) / 127.0
+        err = np.abs(got[name] - values[name])
+        assert (err <= scale / 2 + 1e-7).all(), (name, err.max())
+    carrier = np.asarray(
+        state.params["params"]["fm_embedding"]["embedding"]
+    )
+    np.testing.assert_array_equal(carrier[slots], 0.0)
+
+
+def test_int8_read_rows_requires_quantized_collection():
+    from elasticdl_tpu.store import device as store_device
+
+    state = _fake_state()  # fp32 state: no "quantized" collection
+    paths = {"fm_embedding": ("params", "fm_embedding", "embedding")}
+    with pytest.raises(ValueError, match="quantized"):
+        store_device.read_rows(
+            state, paths, np.array([0], np.int32), cache_dtype="int8"
+        )
+
+
+def test_fold_determinism_keyed_step_and_path():
+    """The write-back's stochastic rounding is keyed on (step, plane
+    path): same step folds identically across calls (the data-parallel
+    replica contract), a different step or a different path draws a
+    different rounding."""
+    from elasticdl_tpu.layers.arena import fold_quantized_updates
+
+    rows, dim = 8, DIM
+    rng = np.random.RandomState(1)
+    planes = {
+        "q8": jnp.asarray(rng.randint(-127, 128, (rows, dim)), jnp.int8),
+        "scale": jnp.asarray(
+            rng.rand(rows, 1).astype(np.float32) + 0.01
+        ),
+    }
+    # a fractional delta that cannot round exactly: the stochastic draw
+    # decides each element, so differing keys are visible in the codes
+    delta = jnp.asarray(
+        (rng.rand(rows, dim).astype(np.float32) - 0.5) * 0.3
+    )
+
+    def fold(name, step):
+        params = {"params": {name: {"embedding": delta}}}
+        state = {"quantized": {name: {"embedding": dict(planes)}}}
+        new_params, new_state = fold_quantized_updates(
+            params, state, step
+        )
+        out = new_state["quantized"][name]["embedding"]
+        # carrier zeroed for the next step
+        np.testing.assert_array_equal(
+            np.asarray(new_params["params"][name]["embedding"]), 0.0
+        )
+        return np.asarray(out["q8"])
+
+    np.testing.assert_array_equal(fold("fm_embedding", 5),
+                                  fold("fm_embedding", 5))
+    assert (fold("fm_embedding", 5) != fold("fm_embedding", 6)).any()
+    assert (fold("fm_embedding", 5) != fold("fm_linear", 5)).any()
+
+
+def _driven_store_int8():
+    """int8 twin of `_driven_store`: same two batches, quantized cache."""
+    store = TieredStore(
+        {"fm_embedding": DIM, "fm_linear": 1}, NUM_FIELDS, CACHE_ROWS,
+        cache_dtype="int8",
+    )
+    store.host.set_backfill(
+        lambda plane, fields, ids: np.repeat(
+            ids.astype(np.float32)[:, None],
+            store.planes[plane], axis=1,
+        )
+    )
+    state = _fake_state_int8()
+    batches = [
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 100,
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 500,
+    ]
+    for sparse in batches:
+        slots, plan = store.prepare(sparse)
+        state = store.apply_plan(state, plan)
+    return store, state, batches
+
+
+def test_int8_store_stats_and_sidecar_round_trip(tmp_path):
+    store, state, batches = _driven_store_int8()
+    stats = store.stats()
+    assert stats["cache_dtype"] == "int8"
+    # analytic value bytes: (dim + 4) per row per plane
+    assert stats["device_cache_bytes"] == CACHE_ROWS * ((DIM + 4) + (1 + 4))
+    store_ckpt.save_sidecar(str(tmp_path), 2, store, state)
+    sidecar = store_ckpt.load_sidecar(str(tmp_path), 2)
+    assert sidecar.cache_dtype == "int8"
+    # raw planes ride in the sidecar; cache_values is their dequant view
+    assert set(sidecar.cache_planes) == {"fm_embedding", "fm_linear"}
+    from elasticdl_tpu.layers.arena import dequantize_rows_host
+
+    planes = sidecar.cache_planes["fm_embedding"]
+    assert planes["q8"].dtype == np.int8
+    np.testing.assert_array_equal(
+        sidecar.cache_values["fm_embedding"],
+        dequantize_rows_host(planes["q8"], planes["scale"]),
+    )
+    # ids are small integers (<= 525): codes quantize within half a bin
+    ids = batches[1].reshape(-1).astype(np.float32)
+    rows = store.host.lookup(batches[1]).reshape(-1)
+    slot_of_row = {int(r): s for s, r in enumerate(store.cache.row_of)
+                   if r >= 0}
+    vals = sidecar.cache_values["fm_embedding"]
+    for raw, r in zip(ids, rows):
+        err = np.abs(vals[slot_of_row[int(r)]] - raw)
+        assert (err <= raw / 127.0 / 2 + 1e-6).all()
+
+
+def test_sidecar_dtype_migration_raises_without_convert(tmp_path):
+    """int8 sidecar into an fp32 store (and the reverse) must fail
+    loudly unless the caller acknowledges the device values were
+    migrated (save_utils passes convert=True after arena_convert)."""
+    store8, state8, _ = _driven_store_int8()
+    store_ckpt.save_sidecar(str(tmp_path), 1, store8, state8)
+    sidecar = store_ckpt.load_sidecar(str(tmp_path), 1)
+    assert sidecar.cache_dtype == "int8"
+
+    fp32_twin = TieredStore(
+        {"fm_embedding": DIM, "fm_linear": 1}, NUM_FIELDS, CACHE_ROWS
+    )
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        fp32_twin.load_sidecar_state(
+            sidecar.host_state, sidecar.row_of, sidecar.score,
+            cache_dtype=sidecar.cache_dtype,
+        )
+    fp32_twin.load_sidecar_state(
+        sidecar.host_state, sidecar.row_of, sidecar.score,
+        cache_dtype=sidecar.cache_dtype, convert=True,
+    )
+    np.testing.assert_array_equal(fp32_twin.cache.row_of, store8.cache.row_of)
+
+    # reverse direction: fp32 sidecar into an int8 store
+    store32, state32, _ = _driven_store(perturb=0.0)
+    store_ckpt.save_sidecar(str(tmp_path), 9, store32, state32)
+    side32 = store_ckpt.load_sidecar(str(tmp_path), 9)
+    assert side32.cache_dtype == "float32"
+    int8_twin = TieredStore(
+        {"fm_embedding": DIM, "fm_linear": 1}, NUM_FIELDS, CACHE_ROWS,
+        cache_dtype="int8",
+    )
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        int8_twin.load_sidecar_state(
+            side32.host_state, side32.row_of, side32.score,
+            cache_dtype=side32.cache_dtype,
+        )
+    int8_twin.load_sidecar_state(
+        side32.host_state, side32.row_of, side32.score,
+        cache_dtype=side32.cache_dtype, convert=True,
+    )
+    np.testing.assert_array_equal(
+        int8_twin.cache.row_of, store32.cache.row_of
+    )
+
+
+def test_partition_plan_union_equals_unsharded_plan():
+    """Mesh seam accounting: the per-device sub-plans are an exact,
+    order-preserving partition of the parent plan — their union IS the
+    unsharded plan, every slot lands on its owning device's block."""
+    from elasticdl_tpu.store.cache import partition_plan
+
+    cache_rows, shards = 64, 4
+    cache = HotRowCache(cache_rows)
+    plan1 = cache.plan(np.arange(60))
+    plan2 = cache.plan(np.arange(40, 100))  # evicts + admits
+    for plan in (plan1, plan2):
+        subs = partition_plan(plan, shards, cache_rows)
+        assert len(subs) == shards
+        block = cache_rows // shards
+        for d, sp in enumerate(subs):
+            assert sp["device"] == d
+            assert sp["slot_lo"] == d * block
+            assert sp["slot_hi"] == (d + 1) * block
+            for key in ("admit_slots", "evict_slots"):
+                s = sp[key]
+                assert ((s >= sp["slot_lo"]) & (s < sp["slot_hi"])).all()
+        for kind in ("admit", "evict"):
+            got_slots = np.concatenate(
+                [sp[f"{kind}_slots"] for sp in subs]
+            )
+            got_rows = np.concatenate([sp[f"{kind}_rows"] for sp in subs])
+            want_slots = getattr(plan, f"{kind}_slots")
+            want_rows = getattr(plan, f"{kind}_rows")
+            order = np.argsort(want_slots, kind="stable")
+            np.testing.assert_array_equal(
+                np.sort(got_slots), want_slots[order]
+            )
+            np.testing.assert_array_equal(
+                got_rows[np.argsort(got_slots, kind="stable")],
+                want_rows[order],
+            )
+    with pytest.raises(ValueError):
+        partition_plan(plan1, 7, cache_rows)  # 64 % 7 != 0
+
+
+def test_store_emits_sub_plans_when_mesh_sharded():
+    store, _, _ = _driven_store(perturb=0.0)
+    assert store.stats()["mesh_shards"] == 1
+    store.set_mesh_shards(4)
+    slots, plan = store.prepare(
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 900
+    )
+    assert plan.sub_plans is not None and len(plan.sub_plans) == 4
+    assert sum(
+        sp["admit_slots"].size for sp in plan.sub_plans
+    ) == plan.admit_slots.size
+    with pytest.raises(ValueError):
+        store.set_mesh_shards(5)  # CACHE_ROWS=32 % 5 != 0
+
+
+def test_prepare_block_unions_batches_and_splits_slots():
+    """Fused multi-step planning: one plan covers the union of K
+    batches, per-batch slot arrays keep their shapes, evictions never
+    touch union rows, and every union row is resident afterwards."""
+    store = TieredStore(
+        {"fm_embedding": DIM, "fm_linear": 1}, NUM_FIELDS, 128
+    )
+    store.host.set_backfill(
+        lambda plane, fields, ids: np.repeat(
+            ids.astype(np.float32)[:, None], store.planes[plane], axis=1
+        )
+    )
+    state = _fake_state(cache_rows=128)
+    # warm the cache so the block's union must evict non-union rows
+    for base in (100, 200, 300, 400):
+        sparse = np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + base
+        slots, warm = store.prepare(sparse)
+        state = store.apply_plan(state, warm)
+    batches = [
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 1000,
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 1013,
+        np.arange(NUM_FIELDS, dtype=np.int64)[None, :] + 1000,  # repeat
+    ]
+    slots_list, plan = store.prepare_block(batches)
+    assert plan.block_batches == 3
+    assert len(slots_list) == 3
+    for sparse, slots in zip(batches, slots_list):
+        assert slots.shape == sparse.shape
+    # identical batches plan identical slots
+    np.testing.assert_array_equal(slots_list[0], slots_list[2])
+    union_rows = set(
+        np.concatenate(
+            [store.host.lookup(b).reshape(-1) for b in batches]
+        ).tolist()
+    )
+    assert set(plan.evict_rows.tolist()).isdisjoint(union_rows)
+    state = store.apply_plan(state, plan)
+    resident = {int(r) for r in store.cache.row_of if r >= 0}
+    assert union_rows <= resident
+    assert store.stats()["block_plans"] == 1
+
+
+def test_fused_block_k8_matches_flat_stack_bitwise():
+    """ISSUE 18c: a K-step fused block (one lax.scan, ONE union
+    admission plan) must reproduce the flat arena's losses bitwise —
+    the eager-parity contract extended to steps_per_execution > 1."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    cap, dim, cache_rows, ids_per_field, batch, k = 1 << 13, 4, 512, 6, 16, 8
+    rng = np.random.RandomState(3)
+    cand = rng.randint(0, 1 << 22, size=(NUM_FIELDS, ids_per_field * 8))
+    cand_rows = hash_rows(
+        np.repeat(np.arange(NUM_FIELDS)[:, None], cand.shape[1], 1),
+        cand, cap,
+    )
+    seen, sel = set(), np.zeros((NUM_FIELDS, ids_per_field), np.int32)
+    for f in range(NUM_FIELDS):
+        picked = 0
+        for j in range(cand.shape[1]):
+            row = int(cand_rows[f, j])
+            if row not in seen:
+                seen.add(row)
+                sel[f, picked] = cand[f, j]
+                picked += 1
+                if picked == ids_per_field:
+                    break
+        assert picked == ids_per_field
+
+    def batch_at(step):
+        brng = np.random.RandomState(4000 + step)
+        pick = brng.randint(0, ids_per_field, (batch, NUM_FIELDS))
+        return {
+            "features": {
+                "dense": brng.rand(batch, 13).astype(np.float32),
+                "sparse": sel[np.arange(NUM_FIELDS)[None, :], pick],
+            },
+            "labels": brng.randint(0, 2, batch).astype(np.int32),
+        }
+
+    def trainer_for(model_def, model_params):
+        spec = get_model_spec("model_zoo", model_def,
+                              model_params=model_params)
+        return Trainer(
+            model=spec.model, optimizer=spec.optimizer, loss_fn=spec.loss,
+            param_sharding_fn=spec.param_sharding,
+        )
+
+    flat_tr = trainer_for(
+        "deepfm.deepfm_functional_api.custom_model",
+        f"vocab_capacity={cap};embed_dim={dim}",
+    )
+    tier_tr = trainer_for(
+        "deepfm.deepfm_tiered.custom_model",
+        f"cache_rows={cache_rows};embed_dim={dim}",
+    )
+    b0 = batch_at(0)
+    flat_state = flat_tr.init_state(jax.random.PRNGKey(0), b0["features"])
+    tier_state = tier_tr.init_state(
+        jax.random.PRNGKey(0),
+        {"dense": b0["features"]["dense"],
+         "slots": np.zeros((batch, NUM_FIELDS), np.int32)},
+    )
+    flat_init = {
+        name: np.array(
+            flat_state.params["params"][name]["embedding"], np.float32
+        )
+        for name in ("fm_embedding", "fm_linear")
+    }
+    store = TieredStore(
+        {"fm_embedding": dim, "fm_linear": 1}, NUM_FIELDS, cache_rows
+    )
+    store.host.set_backfill(
+        lambda plane, fields, ids: flat_init[plane][
+            hash_rows(fields, ids, cap)
+        ]
+    )
+    store.enable_deferred_prepare()
+    tier_tr.tiered_store = store
+
+    batches = [batch_at(s) for s in range(k)]
+    flat_state, flat_losses = flat_tr.train_on_batch_stack(
+        flat_state, batches
+    )
+    tier_state, tier_losses = tier_tr.train_on_batch_stack(
+        tier_state,
+        [store.attach({"features": dict(b["features"]),
+                       "labels": b["labels"]}) for b in batches],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(flat_losses)),
+        np.asarray(jax.device_get(tier_losses)),
+    )
+    assert store.stats()["block_plans"] == 1
+
+
+def test_stack_rejects_eagerly_planned_store_batches():
+    """A batch that already carries `__store_plan__` cannot join a fused
+    block: its plan assumed per-step admission order."""
+    from elasticdl_tpu.common.model_handler import get_model_spec
+    from elasticdl_tpu.worker.trainer import Trainer
+
+    spec = get_model_spec(
+        "model_zoo", "deepfm.deepfm_tiered.custom_model",
+        model_params="cache_rows=512;embed_dim=4",
+    )
+    tr = Trainer(model=spec.model, optimizer=spec.optimizer,
+                 loss_fn=spec.loss,
+                 param_sharding_fn=spec.param_sharding)
+    store = TieredStore(
+        {"fm_embedding": 4, "fm_linear": 1}, NUM_FIELDS, 512
+    )
+    tr.tiered_store = store
+    sparse = np.arange(NUM_FIELDS, dtype=np.int64)[None, :]
+    b = store.attach({
+        "features": {"dense": np.zeros((1, 13), np.float32),
+                     "sparse": sparse},
+        "labels": np.zeros(1, np.int32),
+    })
+    assert "__store_plan__" in b
+    with pytest.raises(ValueError, match="fused multi-step"):
+        tr.train_on_batch_stack(None, [b, b])
