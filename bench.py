@@ -1182,6 +1182,42 @@ def bench_serving_fleet(
     }
 
 
+def _lineage_reconciliation(records):
+    """Reconcile the per-window phase decompositions against the
+    measured ingest->first-serve times (docs/OBSERVABILITY.md "Window
+    lineage"): over completed, non-dropped windows, the p99 of
+    sum(phases) must sit within 5% of the p99 of the measured e2e —
+    the contract that the decomposition accounts for ALL the staleness,
+    not an approximation of it."""
+    done = [
+        r for r in records
+        if r.get("complete") and not r.get("dropped")
+    ]
+    if not done:
+        return {
+            "windows": 0, "phase_sum_p99_s": 0.0, "e2e_p99_s": 0.0,
+            "delta_pct": 0.0, "within_5pct": True,
+            "max_abs_delta_s": 0.0,
+        }
+    sums = np.array([sum(r["phases"].values()) for r in done])
+    e2e = np.array([r["e2e_s"] for r in done])
+    p99_sum = float(np.percentile(sums, 99))
+    p99_e2e = float(np.percentile(e2e, 99))
+    delta_pct = (
+        abs(p99_sum - p99_e2e) / p99_e2e * 100.0 if p99_e2e else 0.0
+    )
+    return {
+        "windows": len(done),
+        "phase_sum_p99_s": round(p99_sum, 6),
+        "e2e_p99_s": round(p99_e2e, 6),
+        "delta_pct": round(delta_pct, 3),
+        "within_5pct": delta_pct <= 5.0,
+        "max_abs_delta_s": round(
+            float(np.max(np.abs(sums - e2e))), 6
+        ),
+    }
+
+
 def _online_chaos_run(seed: int):
     """One seeded chaos pass of the online loop under a FAKE clock and a
     strictly sequential driver: a stream stall (`stream.poll`), a lost
@@ -1189,12 +1225,18 @@ def _online_chaos_run(seed: int):
     (`serving.reload`), a deferred shard move (`store.shard_handoff`),
     a mid-run replica kill, TWO trainer-worker kills (the second retries
     the deferred shard move), and a master restart landed while a window
-    is mid-flight.  Returns (canonical_text, summary): the text
-    concatenates the fault trace, the fleet manager's and SLO
-    evaluator's clock-free decision lists, and the normalized span-event
-    stream — byte-identical across same-seed runs (the acceptance bar
-    of docs/ONLINE.md).  The exactly-once claim is checked in summary:
-    zero lost windows, zero duplicate shard reports."""
+    is mid-flight WITH its reader buffers wiped — the survivors must
+    replay those windows from the deterministic source, and the lineage
+    must keep their ORIGINAL ingest attribution.  Returns
+    (canonical_text, summary): the text concatenates the fault trace,
+    the fleet manager's and SLO evaluator's clock-free decision lists,
+    the normalized span-event stream (window_span lineage stamps
+    included), and the completed window-lineage decompositions —
+    byte-identical across same-seed runs (the acceptance bar of
+    docs/ONLINE.md).  The exactly-once claim is checked in summary:
+    zero lost windows, zero duplicate shard reports; the lineage claim
+    too: phase sums reconcile with measured e2e within 5%, replayed
+    windows keep pre-restart ingest stamps."""
     import tempfile
 
     from elasticdl_tpu.common import events as events_lib
@@ -1227,7 +1269,8 @@ def _online_chaos_run(seed: int):
         seed=seed,
     ))
     keep = ("window", "tasks", "records", "step",
-            "shard", "from_worker", "to_worker")
+            "shard", "from_worker", "to_worker",
+            "window_id", "phase", "reason", "at_unix_s", "ingest_unix_s")
     norm_events = []
 
     def observe(record):
@@ -1239,6 +1282,7 @@ def _online_chaos_run(seed: int):
     events_lib.add_observer(observe)
     rng = np.random.RandomState(seed)
     failed = 0
+    restart_at = None
     try:
         spec = get_model_spec(_ZOO, "clickstream.ctr_mlp.custom_model")
         with tempfile.TemporaryDirectory() as tmp:
@@ -1254,16 +1298,22 @@ def _online_chaos_run(seed: int):
             for i in range(12):
                 if i == 7:
                     # leave the tick's window mid-flight (1 of its 4
-                    # shards trained), then kill the master brain: the
-                    # replacement must re-arm exactly the 3 undone
-                    # shards from the journal
+                    # shards trained), wipe the reader's buffers (full
+                    # master-process amnesia), then kill the master
+                    # brain: the replacement must re-arm exactly the 3
+                    # undone shards from the journal AND replay the
+                    # wiped windows from the deterministic source —
+                    # their lineage must keep the original ingest stamp
                     pipe.tick(max_train_tasks=1)
+                    wiped = pipe.drop_window_buffers()
+                    restart_at = clk[0]
                     restored = pipe.restart_master()
                     faults.note(
                         "master.restart",
-                        "windows=%d tasks=%d" % (
+                        "windows=%d tasks=%d buffers_wiped=%d" % (
                             restored["windows_restored"],
                             restored["tasks_rearmed"],
+                            wiped,
                         ),
                     )
                 else:
@@ -1296,6 +1346,10 @@ def _online_chaos_run(seed: int):
             # drain the restart's re-armed remainder before snapshotting
             pipe.tick()
             snap = pipe.snapshot()
+            lineage_records = pipe.lineage.records()
+            # open windows too: a replayed window still blocked in
+            # reload_wait must already carry its original ingest stamp
+            all_lineage = lineage_records + pipe.lineage.open_decompositions()
             pipe.shutdown()
     finally:
         events_lib.remove_observer(observe)
@@ -1306,6 +1360,7 @@ def _online_chaos_run(seed: int):
         "fleet_decisions": snap["serving_fleet"]["decisions"],
         "slo_decisions": snap["slo"]["decisions"],
         "events": norm_events,
+        "lineage": lineage_records,
     }, sort_keys=True)
     summary = {
         "all_faults_fired": registry.all_fired(),
@@ -1323,6 +1378,24 @@ def _online_chaos_run(seed: int):
         "master_restarts": snap["online"]["master_restarts"],
         "alive_trainers": snap["online"]["alive_trainers"],
         "replayed_windows": snap["stream"]["replayed_windows"],
+        # ---- window lineage (docs/OBSERVABILITY.md "Window lineage") --
+        "lineage_windows": snap["lineage"]["windows_traced"],
+        "lineage_replayed": sum(
+            1 for r in all_lineage if r.get("replayed")
+        ),
+        "lineage_dominant_phase": snap["lineage"]["dominant_phase"],
+        "lineage_reconcile": _lineage_reconciliation(lineage_records),
+        # replayed windows must keep their PRE-restart ingest stamp —
+        # replay re-buffers records, it never re-bases attribution
+        "replayed_original_ingest": (
+            restart_at is not None
+            and any(r.get("replayed") for r in all_lineage)
+            and all(
+                r.get("ingest_unix_s") is not None
+                and float(r["ingest_unix_s"]) < restart_at
+                for r in all_lineage if r.get("replayed")
+            )
+        ),
     }
     return canonical, summary
 
@@ -1411,6 +1484,7 @@ def bench_online(
         elapsed = time.perf_counter() - t0
         staleness = pipe.freshness.quantiles()
         snap = pipe.snapshot()
+        lineage_records = pipe.lineage.records()
         pipe.shutdown()
 
     trace_a, summary_a = _online_chaos_run(chaos_seed)
@@ -1449,6 +1523,16 @@ def bench_online(
             "max_burn_rate": round(snap["max_burn"], 3),
             "watermark_lag_s": snap["stream"]["watermark_lag_s"],
             "dropped_windows": snap["stream"]["dropped_windows"],
+            # per-window staleness decomposition: where the traced
+            # windows' ingest->first-serve time went, and the proof the
+            # phases account for the whole measured e2e
+            "lineage": {
+                "windows_traced": snap["lineage"]["windows_traced"],
+                "e2e_p99_s": snap["lineage"]["e2e_p99_s"],
+                "dominant_phase": snap["lineage"]["dominant_phase"],
+                "phase_p99_s": snap["lineage"]["phase_p99_s"],
+                "reconcile": _lineage_reconciliation(lineage_records),
+            },
             "chaos": {
                 "seed": chaos_seed,
                 "deterministic": trace_a == trace_b,

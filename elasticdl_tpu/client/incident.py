@@ -6,7 +6,8 @@ command is the read side.  With just the directory it lists every
 bundle (seq, trigger, counts); with `--bundle` it renders one into the
 report an operator reads first in a postmortem: what tripped the
 capture, which SLOs were burning, the decisions leading up to the
-incident, the slowest request spans caught in the ring, and any fault
+incident, the slowest request spans caught in the ring, the window
+lineage tail with its dominant freshness phase, and any fault
 injections that were active.
 
 stdlib-only, like `elasticdl top` and `elasticdl trace`: it must run
@@ -17,7 +18,27 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common import flight
+from elasticdl_tpu.common import lineage as lineage_lib
+
+
+def _window_decompositions(records: List[dict]) -> List[dict]:
+    """Per-window freshness decompositions from the bundle's lineage
+    ring, window-id order.  Open windows are charged up to the newest
+    stamp in the ring, attributed to the phase they are blocked in —
+    that is what lets a mid-stall bundle name the guilty phase."""
+    states = lineage_lib.from_events(records)
+    stamps = [
+        float(r["at_unix_s"]) for r in records
+        if r.get("event") == events.WINDOW_SPAN
+        and r.get("at_unix_s") is not None
+    ]
+    now = max(stamps) if stamps else None
+    return [
+        lineage_lib.decompose(states[wid], now=now)
+        for wid in sorted(states)
+    ]
 
 
 def _span_total_s(span: dict) -> float:
@@ -31,7 +52,7 @@ def format_listing(bundles: List[dict]) -> str:
     """One row per bundle, capture order."""
     lines = [
         "bundle".ljust(34) + "trigger".ljust(18)
-        + "spans".rjust(7) + "decisions".rjust(11)
+        + "spans".rjust(7) + "decisions".rjust(11) + "lineage".rjust(9)
     ]
     for manifest in bundles:
         counts = manifest.get("counts", {})
@@ -40,6 +61,7 @@ def format_listing(bundles: List[dict]) -> str:
             + str(manifest.get("trigger", "?")).ljust(18)
             + str(counts.get("spans", 0)).rjust(7)
             + str(counts.get("decisions", 0)).rjust(11)
+            + str(counts.get("lineage", 0)).rjust(9)
         )
     return "\n".join(lines)
 
@@ -107,6 +129,41 @@ def format_report(bundle: Dict[str, object], spans_k: int = 10) -> str:
                 f" [{span.get('reason', '?')}]"
                 f" total={_span_total_s(span) * 1e3:.2f}ms {detail}"
             )
+
+    lineage_records = [
+        r for r in (bundle.get("lineage") or []) if isinstance(r, dict)
+    ]
+    if lineage_records:
+        decomps = _window_decompositions(lineage_records)
+        if decomps:
+            complete = sum(1 for d in decomps if d["complete"])
+            open_ = sum(1 for d in decomps if not d["complete"])
+            dropped = sum(1 for d in decomps if d["dropped"])
+            lines.append("")
+            lines.append(
+                f"window lineage in the ring: {len(decomps)} windows "
+                f"({complete} complete, {open_} open, {dropped} dropped)"
+            )
+            dominant = lineage_lib.dominant_phase(decomps)
+            if dominant:
+                lines.append(f"  dominant phase: {dominant}")
+            for d in decomps[-5:]:
+                flags = "+".join(
+                    f for f in ("dropped", "replayed", "rearmed") if d[f]
+                )
+                phases = d.get("phases") or {}
+                dom = max(phases, key=phases.get) if phases else None
+                state = (
+                    "" if d["complete"]
+                    else f", blocked in {d['blocked_phase'] or '?'}"
+                )
+                lines.append(
+                    f"  window {d['window_id']}"
+                    + (f" [{flags}]" if flags else "")
+                    + f": {d['e2e_s']:.3f}s"
+                    + (f", dominant {dom}" if dom else "")
+                    + state
+                )
 
     faults = bundle.get("faults") or {}
     if isinstance(faults, dict) and faults.get("injected"):

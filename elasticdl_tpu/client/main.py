@@ -97,6 +97,14 @@ def _build_parser() -> argparse.ArgumentParser:
     args_lib.add_trace_params(trace_parser)
     trace_parser.set_defaults(func="trace")
 
+    lineage_parser = subparsers.add_parser(
+        "lineage",
+        help="per-window ingest->first-serve freshness waterfalls from "
+        "an --event_log JSONL (the train-path twin of `trace`)",
+    )
+    args_lib.add_lineage_params(lineage_parser)
+    lineage_parser.set_defaults(func="lineage")
+
     incident_parser = subparsers.add_parser(
         "incident",
         help="list incident flight-recorder bundles (--incident_dir of "
@@ -159,6 +167,10 @@ def main(argv=None) -> int:
         from elasticdl_tpu.client.trace import trace
 
         return trace(args)
+    if args.func == "lineage":
+        from elasticdl_tpu.client.lineage import lineage
+
+        return lineage(args)
     if args.func == "incident":
         from elasticdl_tpu.client.incident import incident
 

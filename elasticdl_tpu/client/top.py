@@ -179,6 +179,20 @@ def render(varz: dict, serving_varz: Optional[dict] = None,
                 obs=freshness.get("observations", 0),
             )
         )
+    lineage = snapshot.get("lineage")
+    if lineage:
+        p99 = lineage.get("e2e_p99_s")
+        lines.append(
+            "lineage: windows={tr} open={op} replayed={rep} "
+            "dropped={drop} e2e_p99={p99} dominant={dom}".format(
+                tr=lineage.get("windows_traced", 0),
+                op=lineage.get("windows_open", 0),
+                rep=lineage.get("replayed", 0),
+                drop=lineage.get("dropped", 0),
+                p99=f"{p99:.2f}s" if p99 is not None else "-",
+                dom=lineage.get("dominant_phase") or "-",
+            )
+        )
     recovery = snapshot.get("recovery")
     if recovery:
         durations = recovery.get("recovery_durations_s", [])

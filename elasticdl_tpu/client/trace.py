@@ -13,7 +13,10 @@ cluster on one timeline:
     claim->trained (training) and trained->reported (report RPC);
   * checkpoint saves/restores, serving hot-reloads, straggler flags and
     per-window step-phase breakdowns as instant events;
-  * each elastic-recovery outage as a slice on the master track.
+  * each elastic-recovery outage as a slice on the master track;
+  * every stream window's lifecycle (`window_span` lineage stamps) as
+    one slice per window on the "windows" track with nested phase
+    segments, dropped/replayed windows flagged in the slice name.
 
 `--summary` skips the JSON and prints per-worker task-latency quantiles,
 the slowest K tasks, and the aggregate step-phase breakdown — the
@@ -29,6 +32,7 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common import events
+from elasticdl_tpu.common import lineage as lineage_lib
 
 # Task-lifecycle chain, in causal order.  A task slice needs at least
 # the first and one later timestamp to have an extent.
@@ -252,6 +256,86 @@ def build_chrome_trace(evts: List[dict]) -> dict:
                 "args": {"request_id": request_id},
             })
             cursor += seconds
+
+    # Window lifecycle -> one slice per stream window on the "windows"
+    # process track (one thread row per window id), nested phase
+    # segments in life order, dropped/replayed windows flagged in the
+    # slice name.  Lineage stamps ride the components' INJECTABLE clock
+    # (`at_unix_s`), which under a fake-clock chaos run is a different
+    # epoch from the emit wall time — so window slices are positioned
+    # against the earliest window stamp (under a real clock the two
+    # epochs coincide and the tracks line up with everything else).
+    states = lineage_lib.from_events(evts)
+    window_anchors = [
+        s["ingest_unix_s"] for s in states.values()
+        if s["ingest_unix_s"] is not None
+    ]
+    if window_anchors:
+        win_pid = 4
+        out.append({
+            "ph": "M", "name": "process_name", "pid": win_pid, "tid": 0,
+            "args": {"name": "windows"},
+        })
+        t0w = min(window_anchors)
+        for wid, state in sorted(states.items()):
+            start = state["ingest_unix_s"]
+            if start is None:
+                continue
+            decomp = lineage_lib.decompose(state)
+            phases = [
+                (p, decomp["phases"][p])
+                for p in lineage_lib.PHASE_ORDER
+                if p in decomp["phases"]
+            ]
+            tid = int(wid)
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": win_pid,
+                "tid": tid, "args": {"name": f"window {wid}"},
+            })
+            flags = [
+                f for f in ("dropped", "replayed", "rearmed")
+                if decomp[f]
+            ]
+            name = f"window {wid}" + (
+                f" [{'+'.join(flags)}]" if flags else ""
+            )
+            args = {
+                "window_id": int(wid),
+                "complete": decomp["complete"],
+                "dropped": decomp["dropped"],
+                "replayed": decomp["replayed"],
+                "rearmed": decomp["rearmed"],
+                "tasks": decomp["tasks"],
+                "records": decomp["records"],
+                "e2e_s": decomp["e2e_s"],
+            }
+            if decomp["blocked_phase"]:
+                args["blocked_phase"] = decomp["blocked_phase"]
+            total = sum(seconds for _, seconds in phases)
+            if total <= 0.0:
+                # sealed-only (or dropped at seal): no extent to draw
+                out.append({
+                    "ph": "i", "name": name, "cat": "window", "s": "t",
+                    "pid": win_pid, "tid": tid,
+                    "ts": _us(start, t0w), "args": args,
+                })
+                continue
+            out.append({
+                "ph": "X", "name": name, "cat": "window",
+                "pid": win_pid, "tid": tid,
+                "ts": _us(start, t0w), "dur": round(total * 1e6, 3),
+                "args": args,
+            })
+            cursor = start
+            for phase, seconds in phases:
+                out.append({
+                    "ph": "X", "name": phase, "cat": "window",
+                    "pid": win_pid, "tid": tid,
+                    "ts": _us(cursor, t0w),
+                    "dur": round(seconds * 1e6, 3),
+                    "args": {"window_id": int(wid)},
+                })
+                cursor += seconds
 
     # Point events + recovery outage slices.
     for e in evts:

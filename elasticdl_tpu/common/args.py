@@ -604,6 +604,24 @@ def add_trace_params(parser: argparse.ArgumentParser):
     )
 
 
+def add_lineage_params(parser: argparse.ArgumentParser):
+    """`elasticdl lineage`: per-window freshness waterfalls from an
+    event log (client/lineage.py)."""
+    parser.add_argument(
+        "event_log",
+        help="span-event JSONL written by --event_log (a rolled "
+        "<path>.1 generation, if present, is read automatically)",
+    )
+    parser.add_argument(
+        "--slowest", type=non_neg_int, default=3,
+        help="how many slowest windows get a full waterfall",
+    )
+    parser.add_argument(
+        "--window", type=int, default=None,
+        help="render the waterfall for this one window id only",
+    )
+
+
 def add_incident_params(parser: argparse.ArgumentParser):
     """`elasticdl incident`: postmortem reports from flight-recorder
     bundles (client/incident.py)."""

@@ -59,6 +59,7 @@ STREAM_WINDOW_RELEASED = "stream_window_released"  # ledger acked trained
 STREAM_WINDOW_RESTORED = "stream_window_restored"  # un-acked replayed
 STORE_SHARD_HANDOFF = "store_shard_handoff"  # row range moved to successor
 SERVING_SCALE = "serving_scale"    # serving policy engine scaled the fleet
+WINDOW_SPAN = "window_span"        # one window-lineage phase stamp
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -73,7 +74,7 @@ VOCABULARY = frozenset({
     INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
     STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED, STREAM_WINDOW_DROPPED,
     STREAM_WINDOW_RELEASED, STREAM_WINDOW_RESTORED, STORE_SHARD_HANDOFF,
-    SERVING_SCALE,
+    SERVING_SCALE, WINDOW_SPAN,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every
@@ -115,6 +116,27 @@ SPAN_PHASES = frozenset({
 })
 SPAN_REASONS = frozenset({
     "sampled", "error", "shed", "failover", "invalid", "internal",
+})
+
+#: Closed vocabularies for the train-path WINDOW_SPAN event — the
+#: lineage twin of PREDICT_SPAN (docs/OBSERVABILITY.md "Window
+#: lineage").  Each emit stamps the hop that CLOSES one named phase of
+#: a window's ingest->first-serve life; `common/lineage.py` joins the
+#: stamps into the staleness decomposition and the
+#: `master_window_phase_seconds{phase=...}` histogram draws its label
+#: from the same set.  `reason` names the hop outcome: "sealed" /
+#: "replayed" for the two ingest stamps, "armed" / "rearmed" for the
+#: arm (first arm vs ledger replay after a master restart), "trained" /
+#: "admitted" per task, "produced" / "reloaded" / "served" for the
+#: checkpoint->fleet->first-predict tail, "dropped" when the window is
+#: forfeited.  Enforced statically by graftlint GL-METRIC rule 6.
+WINDOW_PHASES = frozenset({
+    "ingest_wait", "arm_wait", "train", "admission", "checkpoint",
+    "reload_wait", "serve_wait",
+})
+WINDOW_REASONS = frozenset({
+    "sealed", "replayed", "armed", "rearmed", "trained", "admitted",
+    "produced", "reloaded", "served", "dropped",
 })
 
 #: Triggers the incident flight recorder (common/flight.py) captures

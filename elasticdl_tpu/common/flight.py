@@ -9,9 +9,9 @@ that preceded them had rotated out of every buffer.  The
 always listening, dumping state at the moment of the incident:
 
 - It taps the in-process span-event stream (`events.add_observer`) and
-  keeps bounded rings of recent `predict_span` records and
-  decision-class events (policy decisions, fleet reloads/refusals,
-  replica relaunches, SLO transitions).
+  keeps bounded rings of recent `predict_span` records, `window_span`
+  lineage stamps, and decision-class events (policy decisions, fleet
+  reloads/refusals, replica relaunches, SLO transitions).
 - Triggers — an `slo_breach`, a policy eviction, a `reload_refused` —
   queue a capture; `flush()` (called from the SLO evaluator's
   `on_breach` hook, from `Master.stop()`, or by hand in tests) writes
@@ -116,6 +116,7 @@ class FlightRecorder:
         capacity = max(1, int(ring_capacity))
         self._spans: deque = deque(maxlen=capacity)
         self._decisions: deque = deque(maxlen=capacity)
+        self._lineage: deque = deque(maxlen=capacity)
         # RLock: capture emits INCIDENT_CAPTURED, which re-enters
         # observe() on this same thread through the event tap.
         self._lock = threading.RLock()
@@ -140,6 +141,10 @@ class FlightRecorder:
         with self._lock:
             if event == events.PREDICT_SPAN:
                 self._spans.append(dict(record))
+            elif event == events.WINDOW_SPAN:
+                # the train-path lineage ring: a staleness postmortem
+                # needs the window stamps that preceded the breach
+                self._lineage.append(dict(record))
             elif event in DECISION_EVENTS:
                 self._decisions.append(dict(record))
             if event == events.SLO_BREACH:
@@ -221,12 +226,14 @@ class FlightRecorder:
             seq = self._seq
             spans = [_stable(r) for r in self._spans]
             decisions = [_stable(r) for r in self._decisions]
+            lineage = [_stable(r) for r in self._lineage]
         name = f"incident-{seq:04d}-{trigger}"
         path = os.path.join(self._dir, name)
         try:
             sections: Dict[str, object] = {
                 "spans": spans,
                 "decisions": decisions,
+                "lineage": lineage,
                 "faults": _stable(faults.stats()),
             }
             if self._history is not None:
@@ -250,6 +257,7 @@ class FlightRecorder:
                 "counts": {
                     "spans": len(spans),
                     "decisions": len(decisions),
+                    "lineage": len(lineage),
                 },
                 "files": files,
             })
@@ -289,6 +297,7 @@ class FlightRecorder:
                 "incident_dir": self._dir,
                 "spans_buffered": len(self._spans),
                 "decisions_buffered": len(self._decisions),
+                "lineage_buffered": len(self._lineage),
                 "pending": len(self._pending),
                 "captured": list(self._captured),
             }

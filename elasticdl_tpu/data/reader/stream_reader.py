@@ -258,6 +258,23 @@ class StreamReader(AbstractDataReader):
                 window=window.window_id,
                 records=len(window.records),
             )
+            # Lineage seal stamp: ingest = the window's oldest event
+            # time, at = now — ingest_wait is how long the window took
+            # to fill (docs/OBSERVABILITY.md "Window lineage").
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=window.window_id,
+                phase="ingest_wait",
+                reason="sealed",
+                at_unix_s=round(float(self._clock()), 6),
+                ingest_unix_s=round(
+                    min(
+                        float(r.get("event_unix_s", 0.0))
+                        for r in window.records
+                    ), 6,
+                ),
+                records=len(window.records),
+            )
         for window in dropped:
             # an incident, not a log line: the flight recorder captures
             # a bundle on this event (docs/OBSERVABILITY.md)
@@ -352,6 +369,19 @@ class StreamReader(AbstractDataReader):
             events.STREAM_WINDOW_RESTORED,
             window=int(window_id),
             name=name,
+            records=int(num_records),
+        )
+        # Replay stamp: carries the ORIGINAL journaled watermark as the
+        # ingest time, so a lineage consumer that missed the seal still
+        # attributes the replayed window to its original ingest — it
+        # never re-stamps a window the consumer already opened.
+        events.emit(
+            events.WINDOW_SPAN,
+            window_id=int(window_id),
+            phase="ingest_wait",
+            reason="replayed",
+            at_unix_s=round(float(self._clock()), 6),
+            ingest_unix_s=round(float(watermark_unix_s), 6),
             records=int(num_records),
         )
         return True

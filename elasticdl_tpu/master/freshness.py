@@ -36,12 +36,19 @@ class FreshnessTracker:
         self,
         clock: Callable[[], float] = time.time,
         produced_time_fn: Optional[Callable[[int], Optional[float]]] = None,
+        on_first_serve: Optional[Callable[[int, float], None]] = None,
     ):
+        # `on_first_serve(model_step, at_unix_s)` fires once per distinct
+        # model step the first time a Predict response echoes it — the
+        # serve-side stamp window lineage joins against (called outside
+        # the tracker's lock; must not call back into observe_response).
         self._clock = clock
         self._produced_time_fn = produced_time_fn
+        self.on_first_serve = on_first_serve
         self._lock = threading.Lock()
         self._latest_step = 0
         self._latest_unix_s: Optional[float] = None
+        self._served_steps: set = set()
         self._observations = 0
         self.metrics_registry = metrics_lib.MetricsRegistry()
         self._steps_hist = self.metrics_registry.histogram(
@@ -90,8 +97,19 @@ class FreshnessTracker:
             seconds = max(0.0, float(self._clock()) - latest_unix_s)
         self._steps_hist.record(float(steps))
         self._seconds_hist.record(seconds)
+        first_serve = False
         with self._lock:
             self._observations += 1
+            if int(model_step) not in self._served_steps:
+                self._served_steps.add(int(model_step))
+                first_serve = True
+        if first_serve and self.on_first_serve is not None:
+            try:
+                self.on_first_serve(
+                    int(model_step), float(self._clock())
+                )
+            except Exception:  # lineage must never fail the serve path
+                pass
         return steps, seconds
 
     def quantiles(self) -> dict:

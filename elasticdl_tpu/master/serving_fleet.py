@@ -186,6 +186,10 @@ class ServingFleetManager:
         self._refused_targets = set()
         self._last_skew = 0
         self._max_skew = 0
+        #: Most recent completed reload (replica/step/clock stamp) — the
+        #: reload-sequencing fact window lineage turns into per-window
+        #: `reload_wait` stamps (pipeline reads it after each tick).
+        self._last_reload: Optional[dict] = None
         #: clock-free decision records in tick order (same contract as
         #: PolicyEngine.decisions: byte-comparable across same-seed runs).
         self.decisions: List[dict] = []
@@ -709,6 +713,11 @@ class ServingFleetManager:
             "reload_step", replica=victim, target_step=int(target),
             skew=int(self._last_skew),
         )
+        self._last_reload = {
+            "replica": int(victim),
+            "step": int(target),
+            "unix_s": round(float(self._clock()), 6),
+        }
         events.emit(
             events.FLEET_RELOAD_STEP, replica=victim,
             step=int(target), skew=int(self._last_skew),
@@ -716,6 +725,12 @@ class ServingFleetManager:
         return record
 
     # ---- bookkeeping ---------------------------------------------------
+
+    def last_reload(self) -> Optional[dict]:
+        """Most recent completed sequenced reload
+        ({replica, step, unix_s}) or None before the first swap."""
+        with self._lock:
+            return dict(self._last_reload) if self._last_reload else None
 
     def _record(self, action: str, **inputs) -> dict:
         assert action in FLEET_ACTIONS, action

@@ -594,6 +594,7 @@ class TaskManager:
         self._training_records_done = max(0, saved_records)
         self.counters.records_done = self._training_records_done
         rearmed_windows = rearmed_tasks = 0
+        rearmed_stamps: List[tuple] = []
         for entry in windows:
             wid = entry.pop("window_id")
             self._window_ledger[wid] = entry
@@ -613,7 +614,20 @@ class TaskManager:
             if rearmed:
                 rearmed_windows += 1
                 rearmed_tasks += rearmed
+                rearmed_stamps.append((int(wid), rearmed))
         self._prune_released_locked()
+        for wid, n in rearmed_stamps:
+            # Ledger-replay lineage stamp: the lineage join keeps the
+            # ORIGINAL armed time when it saw the first arm, so a
+            # restart only flags the window `rearmed`, never re-bases it.
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=wid,
+                phase="arm_wait",
+                reason="rearmed",
+                at_unix_s=round(float(self._clock()), 6),
+                tasks=n,
+            )
         logger.info(
             "Restored window ledger: %d windows journaled, %d unfinished "
             "re-armed (%d tasks), armed_floor=%d",
@@ -698,6 +712,18 @@ class TaskManager:
             else window_name,
             tasks=n,
         )
+        if window_id is not None:
+            # Lineage arm stamp closes arm_wait; a window that bounced
+            # off a `task.rearm` fault stamps only when the re-offer
+            # finally lands, so the fault's delay is charged to arm_wait.
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=int(window_id),
+                phase="arm_wait",
+                reason="armed",
+                at_unix_s=round(float(self._clock()), 6),
+                tasks=n,
+            )
         return n
 
     def _prune_released_locked(self) -> None:

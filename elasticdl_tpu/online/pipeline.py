@@ -51,8 +51,10 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import events
 from elasticdl_tpu.common import metrics as metrics_lib
 from elasticdl_tpu.common.history import MetricHistory
+from elasticdl_tpu.common.lineage import WindowLineage
 from elasticdl_tpu.common.k8s_client import FakeK8sClient
 from elasticdl_tpu.common.constants import PodStatus
 from elasticdl_tpu.common.log_utils import get_logger
@@ -259,6 +261,14 @@ class OnlinePipeline:
         self.spec = spec
         self._clock = clock
 
+        # ---- window lineage (docs/OBSERVABILITY.md "Window lineage") ----
+        # Tapped on the event stream BEFORE any collaborator can emit a
+        # `window_span`, so every hop of every window joins.  The
+        # broadcast hops (checkpoint / reload / first serve) fan out to
+        # per-window stamps below via the lineage's join queries.
+        self.lineage = WindowLineage(clock=clock)
+        self.lineage.install()
+
         # ---- stream -> windows ------------------------------------------
         self.source = source if source is not None else ClickStreamSource(
             seed=cfg.seed, users=cfg.source_users, items=cfg.source_items,
@@ -353,6 +363,7 @@ class OnlinePipeline:
             produced_time_fn=lambda step: (
                 self.saver.produced_meta(step) or {}
             ).get("produced_unix_s"),
+            on_first_serve=self._note_first_serve,
         )
         self.router = FleetRouter(
             retry_policy=RetryPolicy(
@@ -431,6 +442,7 @@ class OnlinePipeline:
                 self.reader.metrics_registry,
                 self.task_manager.counters.registry,
                 self.store.registry,
+                self.lineage.registry,
             ],
             clock=clock,
         )
@@ -523,6 +535,7 @@ class OnlinePipeline:
         trained = self._drain_tasks(max_train_tasks)
         saved = self._maybe_checkpoint()
         self.fleet_manager.tick()
+        self._stamp_reloads()
         self.history.tick()
         self.evaluator.tick()
         if self.serving_policy is not None:
@@ -538,6 +551,41 @@ class OnlinePipeline:
             "loss": self._last_loss,
             "backpressured": backpressured,
         }
+
+    def _stamp_reloads(self) -> None:
+        """Fan the fleet's latest sequenced reload out into per-window
+        `reload_wait` lineage stamps.  `windows_awaiting_reload` only
+        matches windows whose covering checkpoint step the reload
+        actually carries, so a stale record from an earlier tick can
+        never stamp a window produced after it."""
+        info = self.fleet_manager.last_reload()
+        if not info:
+            return
+        for window_id in self.lineage.windows_awaiting_reload(
+                info["step"]):
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=int(window_id),
+                phase="reload_wait",
+                reason="reloaded",
+                at_unix_s=round(float(info["unix_s"]), 6),
+                step=int(info["step"]),
+                replica=int(info["replica"]),
+            )
+
+    def _note_first_serve(self, model_step: int, at_unix_s: float) -> None:
+        """FreshnessTracker hook: the first Predict response echoing a
+        new model step closes serve_wait for every window that step's
+        checkpoint covered."""
+        for window_id in self.lineage.windows_awaiting_serve(model_step):
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=int(window_id),
+                phase="serve_wait",
+                reason="served",
+                at_unix_s=round(float(at_unix_s), 6),
+                step=int(model_step),
+            )
 
     def _refresh_pressure(self) -> None:
         """Recompute `serving_pressure` from this tick's router deltas
@@ -611,7 +659,32 @@ class OnlinePipeline:
             self.state, loss = self.trainer.train_on_batch(
                 self.state, batch
             )
+            lineage_wid = self._window_ids.get(name)
+            if lineage_wid is not None:
+                # Per-task train-completion stamp; the lineage join keeps
+                # the LAST task's stamp as the window's train boundary.
+                events.emit(
+                    events.WINDOW_SPAN,
+                    window_id=int(lineage_wid),
+                    phase="train",
+                    reason="trained",
+                    at_unix_s=round(float(self._clock()), 6),
+                    step=int(self.state.step),
+                    start=int(task.shard.start),
+                )
             self._fold_store_stats(records)
+            if lineage_wid is not None:
+                # Admission stamp right after the tiered-store fold: the
+                # admission phase is the store's plan+fold latency for
+                # this window's rows.
+                events.emit(
+                    events.WINDOW_SPAN,
+                    window_id=int(lineage_wid),
+                    phase="admission",
+                    reason="admitted",
+                    at_unix_s=round(float(self._clock()), 6),
+                    rows=2 * len(records),
+                )
             self._last_loss = float(loss)
             self._examples_trained += len(records)
             trained += 1
@@ -660,6 +733,15 @@ class OnlinePipeline:
         window_id = self._window_ids.pop(name, None)
         if window_id is not None:
             self.task_manager.forfeit_window(window_id)
+            # Lineage drop stamp: the window died mid-train; its partial
+            # decomposition finalizes flagged `dropped`.
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=int(window_id),
+                phase="train",
+                reason="dropped",
+                at_unix_s=round(float(self._clock()), 6),
+            )
         self._window_tasks_left.pop(name, None)
         released = self.reader.release_window(name)
         logger.error(
@@ -702,6 +784,25 @@ class OnlinePipeline:
             return False   # injected checkpoint.write fault: next cadence
         self.saver.wait_until_finished()
         self._latest_saved = int(self.state.step)
+        # Checkpoint lineage stamps, one per covered window, timed by
+        # the manifest's own `produced` stamp (the PR 10 freshness
+        # reference) so the reload_wait segment is measured from the
+        # exact instant the staleness histograms measure from.
+        produced = (
+            self.saver.produced_meta(self._latest_saved) or {}
+        ).get("produced_unix_s")
+        if produced is None:
+            produced = float(self._clock())
+        for window_id in self.lineage.windows_awaiting_checkpoint(
+                self._latest_saved):
+            events.emit(
+                events.WINDOW_SPAN,
+                window_id=int(window_id),
+                phase="checkpoint",
+                reason="produced",
+                at_unix_s=round(float(produced), 6),
+                step=self._latest_saved,
+            )
         # Sharded-store sidecar rides the same cadence: it is the state
         # `rebuild_shard` recovers a handed-off shard's host rows from.
         store_checkpoint.save_sharded_sidecar(
@@ -771,6 +872,18 @@ class OnlinePipeline:
             worker_id, recovered, len(moves),
         )
         return {"recovered_tasks": recovered, "handoffs": len(moves)}
+
+    def drop_window_buffers(self) -> int:
+        """Chaos helper: evict every still-open window's buffered
+        records (the amnesia a full master-process loss would inflict)
+        so subsequent leases must replay them from the deterministic
+        source — the path that proves replayed windows keep their
+        original ingest attribution."""
+        dropped = 0
+        for entry in self.task_manager.open_windows():
+            if self.reader.release_window(entry["name"]):
+                dropped += 1
+        return dropped
 
     def restart_master(self) -> dict:
         """Chaos helper: the master's brain dies and a replacement
@@ -868,6 +981,7 @@ class OnlinePipeline:
             "tasks": self.task_manager.snapshot(),
             "serving_fleet": self.fleet_manager.snapshot(),
             "freshness": self.freshness.snapshot(),
+            "lineage": self.lineage.snapshot(),
             "slo": slo,
             "store": self.store.stats(),
             "trainers": {
@@ -895,6 +1009,7 @@ class OnlinePipeline:
         }
 
     def shutdown(self) -> None:
+        self.lineage.close()
         for rep in self._fleet.values():
             rep["batcher"].shutdown()
         self.saver.close()

@@ -41,6 +41,15 @@ patterns over elasticdl_tpu/:
    must be a string literal from SPAN_PHASES — the same closed sets
    the `serving_request_phase_seconds{phase}` histogram and
    docs/OBSERVABILITY.md draw from.
+
+6. **Window-lineage fields.**  Every `emit(events.WINDOW_SPAN, ...)`
+   must carry a `window_id=` kwarg (a lineage stamp the join cannot key
+   by window is unattributable), a `phase=` string literal from
+   WINDOW_PHASES, and a `reason=`, if present, that is a string literal
+   from WINDOW_REASONS — the closed sets the
+   `master_window_phase_seconds{phase}` histogram, common/lineage.py's
+   join, and docs/OBSERVABILITY.md "Window lineage" draw from.  The
+   train-path mirror of pattern 5.
 """
 
 from __future__ import annotations
@@ -68,6 +77,8 @@ from elasticdl_tpu.common.events import (  # noqa: E402
     SERVING_SCALE_REASONS,
     SPAN_PHASES,
     SPAN_REASONS,
+    WINDOW_PHASES,
+    WINDOW_REASONS,
 )
 from elasticdl_tpu.common.metrics import validate_metric_name  # noqa: E402
 
@@ -313,6 +324,60 @@ def find_untraced_predict_spans(tree: ast.AST):
                 )
 
 
+def find_untraced_window_spans(tree: ast.AST):
+    """Yield (lineno, message) for `emit(events.WINDOW_SPAN, ...)`
+    calls missing `window_id=`, missing a `phase=` string literal from
+    WINDOW_PHASES, or whose `reason=`, if present, is computed or
+    outside WINDOW_REASONS — the train-path mirror of
+    find_untraced_predict_spans."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Attribute)
+                and first.attr == "WINDOW_SPAN"):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        if "window_id" not in kwargs:
+            yield (
+                node.lineno,
+                "emit(events.WINDOW_SPAN, ...) must carry window_id= — "
+                "a lineage stamp the freshness join cannot key by "
+                "window is unattributable",
+            )
+        for field, vocab, required in (
+            ("phase", WINDOW_PHASES, True),
+            ("reason", WINDOW_REASONS, False),
+        ):
+            value = kwargs.get(field)
+            if value is None:
+                if required:
+                    yield (
+                        node.lineno,
+                        "emit(events.WINDOW_SPAN, ...) must carry "
+                        f"{field}= so the staleness decomposition can "
+                        "charge the stamp to a lineage phase",
+                    )
+            elif not (isinstance(value, ast.Constant)
+                      and isinstance(value.value, str)):
+                yield (
+                    node.lineno,
+                    f"emit(events.WINDOW_SPAN, ...): {field}= must be "
+                    "a string literal from the closed vocabulary in "
+                    "common/events.py, not a computed value",
+                )
+            elif value.value not in vocab:
+                yield (
+                    node.lineno,
+                    f"emit(events.WINDOW_SPAN, ...): "
+                    f"{field}={value.value!r} is not in the closed "
+                    f"vocabulary {sorted(vocab)}",
+                )
+
+
 def find_shadow_counters(tree: ast.AST):
     """Yield (lineno, message, attr_or_None) for private tallies:
     `self.x = 0` counter-shaped attrs and collections.Counter
@@ -382,6 +447,8 @@ class MetricRule(Rule):
         for lineno, message in find_unlabeled_serving_scales(pf.tree):
             yield Finding(pf.rel, lineno, self.id, message)
         for lineno, message in find_untraced_predict_spans(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+        for lineno, message in find_untraced_window_spans(pf.tree):
             yield Finding(pf.rel, lineno, self.id, message)
         if pf.rel in INSTRUMENTED:
             for lineno, message, attr in find_shadow_counters(pf.tree):

@@ -6,7 +6,8 @@ behind live predicts (docs/ONLINE.md) — and prints two
 machine-readable lines:
 
     ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b> \
-        windows_armed=<a> windows_lost=<l> handoffs=<h>
+        windows_armed=<a> windows_lost=<l> handoffs=<h> \
+        freshness_budget_worst_phase=<p> lineage_windows=<n>
     TRAFFIC_SUMMARY offered_qps=<q> shed_ratio=<r> scale_actions=<n> \
         failed_requests=<f> fleet=<k>
 
@@ -102,6 +103,13 @@ def smoke_summary(windows: int = WINDOWS,
         "windows_armed": snap["online"]["windows_armed"],
         "windows_lost": snap["online"]["windows_lost"],
         "handoffs": snap["online"]["handoffs"],
+        # Per-window lineage (docs/OBSERVABILITY.md "Window lineage"):
+        # which freshness phase dominated the traced windows, and how
+        # many windows the tracer closed end-to-end.
+        "freshness_budget_worst_phase": (
+            snap["lineage"]["dominant_phase"] or "-"
+        ),
+        "lineage_windows": snap["lineage"]["windows_traced"],
     }
 
 
@@ -206,7 +214,9 @@ def main() -> int:
         "ONLINE_SUMMARY train_eps={eps:.1f} qps={qps:.1f} "
         "staleness_p99_s={stale:.4f} burn={burn:.3f} "
         "windows_armed={armed} windows_lost={lost} "
-        "handoffs={handoffs}".format(
+        "handoffs={handoffs} "
+        "freshness_budget_worst_phase={phase} "
+        "lineage_windows={lineage}".format(
             eps=summary["train_eps"],
             qps=summary["qps"],
             stale=summary["staleness_p99_s"],
@@ -214,6 +224,8 @@ def main() -> int:
             armed=summary["windows_armed"],
             lost=summary["windows_lost"],
             handoffs=summary["handoffs"],
+            phase=summary["freshness_budget_worst_phase"],
+            lineage=summary["lineage_windows"],
         )
     )
     traffic = traffic_summary()

@@ -7,7 +7,8 @@
 #   STORE_SUMMARY hit_rate=<r> growth_rows=<n> cache_dtype=<d> \
 #       device_cache_bytes=<b> int8_bytes_reduction=<x> \
 #       per_chip_cache_bytes=<b/8>
-#   ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b>
+#   ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b> \
+#       freshness_budget_worst_phase=<p> lineage_windows=<n>
 #   TIER1_SUMMARY passed=<N> wall_s=<S> lint_findings=<L> status=<ok|fail>
 # so CI (and the roadmap driver) can scrape the tier-1 outcome — and the
 # tiered store's cache efficacy (docs/PERF.md "Tiered embedding store")

@@ -161,10 +161,10 @@ def test_capture_writes_self_contained_bundle(tmp_path):
     manifest = bundle["manifest"]
     assert manifest["format"] == 1
     assert manifest["bundle"] == "incident-0001-manual"
-    assert manifest["counts"] == {"spans": 2, "decisions": 1}
+    assert manifest["counts"] == {"spans": 2, "decisions": 1, "lineage": 0}
     assert sorted(manifest["files"]) == [
         "decisions.json", "faults.json", "history.json",
-        "master.json", "spans.json",
+        "lineage.json", "master.json", "spans.json",
     ]
     # run-variant fields are stripped everywhere a bundle persists
     assert manifest["evidence"] == {"note": "operator"}

@@ -1,0 +1,454 @@
+"""Window-lineage acceptance (docs/OBSERVABILITY.md "Window lineage"):
+`window_span` stamps join into a seven-phase ingest->first-serve
+decomposition whose sum reconciles against measured staleness exactly,
+replayed windows keep their original ingest attribution, open windows
+are charged to the phase they are blocked in, and the operator surfaces
+(`elasticdl lineage` / `trace` / `incident` / `top`) render it — with
+the induced reload-stall postmortem naming `reload_wait`."""
+
+import ast
+import json
+
+import pytest
+
+from elasticdl_tpu.common import events, faults
+from elasticdl_tpu.common import lineage as lineage_lib
+from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
+from elasticdl_tpu.common.lineage import WindowLineage
+from elasticdl_tpu.common.model_handler import get_model_spec
+from elasticdl_tpu.online import OnlineConfig, OnlinePipeline
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    yield
+    events.configure(None)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_model_spec(
+        "model_zoo", "clickstream.ctr_mlp.custom_model"
+    )
+
+
+def _stamp(wid, phase, reason, at, **extra):
+    record = {
+        "ts": at, "pid": 1, "event": events.WINDOW_SPAN,
+        "window_id": wid, "phase": phase, "reason": reason,
+        "at_unix_s": at,
+    }
+    record.update(extra)
+    return record
+
+
+def _life(wid, t0, step=3):
+    """One full window life on a single clock: phases 1/1/2/1/2/2/1s,
+    e2e exactly 10s."""
+    return [
+        _stamp(wid, "ingest_wait", "sealed", t0 + 1.0,
+               ingest_unix_s=t0, records=32),
+        _stamp(wid, "arm_wait", "armed", t0 + 2.0),
+        _stamp(wid, "train", "trained", t0 + 4.0, step=step),
+        _stamp(wid, "admission", "admitted", t0 + 5.0),
+        _stamp(wid, "checkpoint", "produced", t0 + 7.0, step=step),
+        _stamp(wid, "reload_wait", "reloaded", t0 + 9.0, step=step),
+        _stamp(wid, "serve_wait", "served", t0 + 10.0, step=step),
+    ]
+
+
+# ---- the decomposition ---------------------------------------------------
+
+
+def test_phase_order_matches_the_closed_vocabulary():
+    assert set(lineage_lib.PHASE_ORDER) == events.WINDOW_PHASES
+    assert all(
+        s["reason"] in events.WINDOW_REASONS for s in _life(0, 0.0)
+    )
+
+
+def test_decomposition_sums_to_measured_e2e():
+    """The reconciliation contract: all seven phases present, their sum
+    IS served - ingest (one monotone clock, no residual)."""
+    states = lineage_lib.from_events(_life(0, 100.0))
+    d = lineage_lib.decompose(states[0])
+    assert d["complete"] and not d["dropped"]
+    assert d["phases"] == {
+        "ingest_wait": 1.0, "arm_wait": 1.0, "train": 2.0,
+        "admission": 1.0, "checkpoint": 2.0, "reload_wait": 2.0,
+        "serve_wait": 1.0,
+    }
+    assert d["e2e_s"] == 10.0
+    assert round(sum(d["phases"].values()), 6) == d["e2e_s"]
+    assert d["ingest_unix_s"] == 100.0
+    assert d["served_unix_s"] == 110.0
+    assert d["step"] == 3 and d["records"] == 32 and d["tasks"] == 1
+
+
+def test_first_stamp_wins_except_per_task_boundaries():
+    """Seal/serve boundaries are first-stamp-wins (a replay can never
+    move them); trained is per-task with the LAST task closing the
+    phase."""
+    evts = _life(3, 50.0)
+    evts.insert(1, _stamp(3, "ingest_wait", "sealed", 99.0,
+                          ingest_unix_s=90.0, records=64))
+    evts.append(_stamp(3, "train", "trained", 58.0, step=4))
+    evts.append(_stamp(3, "serve_wait", "served", 99.0))
+    state = lineage_lib.from_events(evts)[3]
+    assert state["sealed_unix_s"] == 51.0      # duplicate seal ignored
+    assert state["ingest_unix_s"] == 50.0
+    assert state["records"] == 32
+    assert state["trained_unix_s"] == 58.0     # max over tasks
+    assert state["tasks_trained"] == 2
+    assert state["step"] == 4
+    assert state["served_unix_s"] == 60.0      # duplicate serve ignored
+
+
+def test_replay_keeps_original_ingest_attribution():
+    # seal observed first: the replay stamp must not move ingest
+    evts = [
+        _stamp(7, "ingest_wait", "sealed", 11.0,
+               ingest_unix_s=10.0, records=32),
+        _stamp(7, "ingest_wait", "replayed", 44.0,
+               ingest_unix_s=44.0, records=32),
+    ]
+    state = lineage_lib.from_events(evts)[7]
+    assert state["replayed"]
+    assert state["ingest_unix_s"] == 10.0
+
+    # seal never observed (buffers wiped before the join existed): the
+    # replay stamp carries the journaled watermark = original ingest
+    evts = [_stamp(8, "ingest_wait", "replayed", 44.0,
+                   ingest_unix_s=12.0, records=32)]
+    d = lineage_lib.decompose(
+        lineage_lib.from_events(evts)[8], now=50.0
+    )
+    assert d["replayed"] and not d["complete"]
+    assert d["ingest_unix_s"] == 12.0
+    assert d["blocked_phase"] == "arm_wait"
+
+
+def test_open_window_is_charged_to_its_blocked_phase():
+    """A mid-incident decomposition charges elapsed time to the phase
+    the window is stuck in — what lets a live stall be named."""
+    evts = _life(1, 200.0)[:5]     # through produced; reload never came
+    state = lineage_lib.from_events(evts)[1]
+    d = lineage_lib.decompose(state, now=247.0)
+    assert not d["complete"]
+    assert d["blocked_phase"] == "reload_wait"
+    assert d["phases"]["reload_wait"] == 40.0  # 247 - produced@207
+    assert "served_unix_s" not in d
+    assert d["e2e_s"] == round(sum(d["phases"].values()), 6)
+
+
+# ---- the live aggregator -------------------------------------------------
+
+
+def test_tap_installs_on_the_event_stream_and_closes():
+    lin = WindowLineage(clock=lambda: 0.0)
+    lin.install()
+    try:
+        events.emit(
+            events.WINDOW_SPAN, window_id=5, phase="ingest_wait",
+            reason="sealed", at_unix_s=1.0, ingest_unix_s=0.5, records=8,
+        )
+    finally:
+        lin.close()
+    events.emit(
+        events.WINDOW_SPAN, window_id=6, phase="ingest_wait",
+        reason="sealed", at_unix_s=1.0, ingest_unix_s=0.5, records=8,
+    )
+    assert lin.snapshot()["windows_open"] == 1   # tap removed before 6
+
+
+def test_ring_finalizes_completed_and_dropped_windows():
+    lin = WindowLineage(clock=lambda: 1000.0)
+    for record in _life(0, 100.0):
+        lin.observe(record)
+    for record in _life(1, 300.0)[:5]:           # stays open
+        lin.observe(record)
+    lin.observe(_stamp(2, "ingest_wait", "sealed", 401.0,
+                       ingest_unix_s=400.0, records=32))
+    lin.observe({
+        "ts": 1.0, "pid": 9, "event": events.STREAM_WINDOW_DROPPED,
+        "window": 2, "records": 32,
+    })
+    recs = lin.records()
+    assert [r["window_id"] for r in recs] == [0, 2]
+    assert recs[0]["complete"] and not recs[0]["dropped"]
+    assert recs[1]["dropped"] and not recs[1]["complete"]
+    snap = lin.snapshot()
+    assert snap["windows_traced"] == 1
+    assert snap["windows_open"] == 1
+    assert snap["dropped"] == 1
+    assert snap["e2e_p99_s"] == 10.0
+    assert snap["dominant_phase"] in lineage_lib.PHASE_ORDER
+    assert set(snap["phase_p99_s"]) <= set(lineage_lib.PHASE_ORDER)
+    # the open window's live view charges its blocked phase up to now
+    (open_d,) = lin.open_decompositions()
+    assert open_d["window_id"] == 1
+    assert open_d["blocked_phase"] == "reload_wait"
+    assert open_d["phases"]["reload_wait"] == 1000.0 - 307.0
+
+
+def test_pipeline_join_queries_follow_the_window_through_the_tail():
+    """The fan-out queries the pipeline uses to turn fleet-level facts
+    (a save, a reload, a predict) into per-window stamps."""
+    lin = WindowLineage(clock=lambda: 0.0)
+    for record in _life(4, 100.0)[:4]:           # sealed..admitted
+        lin.observe(record)
+    assert lin.windows_awaiting_checkpoint(3) == [4]
+    assert lin.windows_awaiting_checkpoint(2) == []  # save too old
+    assert lin.windows_awaiting_reload(3) == []
+    lin.observe(_stamp(4, "checkpoint", "produced", 107.0, step=3))
+    assert lin.windows_awaiting_checkpoint(3) == []
+    assert lin.windows_awaiting_reload(3) == [4]
+    assert lin.windows_awaiting_serve(3) == []
+    lin.observe(_stamp(4, "reload_wait", "reloaded", 109.0, step=3))
+    assert lin.windows_awaiting_reload(3) == []
+    assert lin.windows_awaiting_serve(3) == [4]
+    lin.observe(_stamp(4, "serve_wait", "served", 110.0, step=3))
+    assert lin.windows_awaiting_serve(3) == []
+    assert lin.records()[-1]["window_id"] == 4
+    # a forfeited window is discarded from the open joins entirely
+    lin.observe(_stamp(9, "ingest_wait", "sealed", 120.0,
+                       ingest_unix_s=119.0, records=32))
+    lin.discard(9)
+    assert lin.snapshot()["windows_open"] == 0
+
+
+# ---- `elasticdl lineage` -------------------------------------------------
+
+
+def _write_log(tmp_path, evts):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as fh:
+        for record in evts:
+            fh.write(json.dumps(record) + "\n")
+    return path
+
+
+def test_lineage_cli_reports_phases_and_slowest_windows(
+    tmp_path, capsys
+):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    log = _write_log(tmp_path, _life(0, 100.0) + _life(1, 300.0)[:5])
+    rc = cli_main(["lineage", log])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert ("windows traced: 2 (1 complete, 1 open, 0 dropped, "
+            "0 replayed)") in out
+    assert "ingest->first-serve: p50=10.000s" in out
+    assert "dominant phase:" in out
+    assert "slowest 2 windows:" in out
+    assert "blocked in reload_wait" in out
+    assert "ingest_wait" in out and "serve_wait" in out
+
+    rc = cli_main(["lineage", log, "--window", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "window 0: 10.000s" in out
+    assert "serve_wait" in out
+
+
+def test_lineage_cli_rejects_logs_without_window_spans(
+    tmp_path, capsys
+):
+    from elasticdl_tpu.client.main import main as cli_main
+
+    log = _write_log(tmp_path, [{"ts": 1.0, "event": "task_trained"}])
+    rc = cli_main(["lineage", log])
+    assert rc == 1
+    assert "no window_span events" in capsys.readouterr().out
+
+
+# ---- `elasticdl trace` window tracks -------------------------------------
+
+
+def test_trace_renders_window_lifecycle_tracks():
+    from elasticdl_tpu.client.trace import build_chrome_trace
+
+    doc = build_chrome_trace(_life(0, 100.0) + _life(1, 300.0)[:5])
+    tracks = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "windows" in tracks
+    slices = [
+        e for e in doc["traceEvents"]
+        if e.get("cat") == "window" and e.get("ph") == "X"
+    ]
+    top = [e for e in slices if e["name"].startswith("window ")]
+    assert {e["args"]["window_id"] for e in top} == {0, 1}
+    w0 = next(e for e in top if e["args"]["window_id"] == 0)
+    assert w0["args"]["complete"] is True
+    assert w0["ts"] == 0.0                       # anchored at ingest
+    assert w0["dur"] == 10.0 * 1e6
+    w1 = next(e for e in top if e["args"]["window_id"] == 1)
+    assert w1["args"]["complete"] is False
+    assert w1["args"]["blocked_phase"] == "reload_wait"
+    segments = {
+        e["name"] for e in slices if not e["name"].startswith("window ")
+    }
+    assert {"ingest_wait", "train", "serve_wait"} <= segments
+
+
+# ---- `elasticdl incident` + `elasticdl top` ------------------------------
+
+
+def test_incident_report_renders_lineage_tail():
+    from elasticdl_tpu.client.incident import (
+        format_listing,
+        format_report,
+    )
+
+    bundle = {
+        "manifest": {"bundle": "incident-0001-manual",
+                     "trigger": "manual", "evidence": {}},
+        "lineage": _life(0, 100.0) + _life(1, 300.0)[:5],
+    }
+    report = format_report(bundle)
+    assert ("window lineage in the ring: 2 windows "
+            "(1 complete, 1 open, 0 dropped)") in report
+    assert "dominant phase:" in report
+    assert "window 0" in report and ": 10.000s" in report
+    assert "blocked in reload_wait" in report
+
+    listing = format_listing([{
+        "bundle": "incident-0001-manual", "trigger": "manual",
+        "counts": {"spans": 0, "decisions": 0, "lineage": 12},
+    }])
+    assert "lineage" in listing.splitlines()[0]
+    assert "12" in listing.splitlines()[1]
+
+
+def test_top_renders_lineage_line():
+    from elasticdl_tpu.client.top import render as top_render
+
+    frame = top_render({"snapshot": {
+        "tasks": {},
+        "lineage": {
+            "windows_traced": 6, "windows_open": 2, "replayed": 1,
+            "dropped": 0, "e2e_p99_s": 12.5,
+            "dominant_phase": "reload_wait",
+        },
+    }})
+    (line,) = [
+        l for l in frame.splitlines() if l.startswith("lineage:")
+    ]
+    assert "windows=6" in line
+    assert "open=2" in line
+    assert "replayed=1" in line
+    assert "e2e_p99=12.50s" in line
+    assert "dominant=reload_wait" in line
+    # a master without the lineage section renders no lineage line
+    assert "lineage:" not in top_render({"snapshot": {"tasks": {}}})
+
+
+# ---- the induced reload stall --------------------------------------------
+
+
+def test_reload_stall_incident_names_reload_wait(spec, tmp_path):
+    """The acceptance scenario: every fleet reload attempt dies on a
+    scheduled `fleet.reload_step` fault, so trained-and-checkpointed
+    windows pile up blocked in reload_wait — and the flight-recorder
+    bundle captured mid-stall names reload_wait as the dominant phase
+    in its postmortem."""
+    from elasticdl_tpu.client.incident import format_report
+    from elasticdl_tpu.common.flight import FlightRecorder, load_bundle
+
+    clk = [4_000_000.0]
+
+    def clock():
+        clk[0] += 0.125
+        return clk[0]
+
+    cfg = OnlineConfig(
+        seed=13, window_records=32, records_per_poll=32,
+        records_per_task=8, checkpoint_every_windows=1, replicas=1,
+    )
+    recorder = FlightRecorder(
+        incident_dir=str(tmp_path / "incidents"), ring_capacity=256,
+    ).install()
+    faults.install(FaultRegistry(schedule=[
+        FaultSpec(faults.POINT_FLEET_RELOAD_STEP, i, "raise")
+        for i in range(16)
+    ], seed=13))
+    pipe = OnlinePipeline(str(tmp_path / "run"), spec, cfg, clock=clock)
+    try:
+        for _ in range(6):
+            pipe.tick()
+        open_d = pipe.lineage.open_decompositions()
+        assert open_d, "stalled reloads must leave windows open"
+        assert all(
+            d["blocked_phase"] == "reload_wait" for d in open_d
+        )
+        assert (
+            pipe.snapshot()["lineage"]["dominant_phase"]
+            == "reload_wait"
+        )
+        assert recorder.snapshot()["lineage_buffered"] > 0
+        path = recorder.capture(
+            "manual", evidence={"note": "reload stall"}
+        )
+    finally:
+        faults.uninstall()
+        recorder.close()
+        pipe.shutdown()
+    bundle = load_bundle(path)
+    assert bundle["manifest"]["counts"]["lineage"] > 0
+    report = format_report(bundle)
+    assert "window lineage in the ring:" in report
+    assert "dominant phase: reload_wait" in report
+    assert "blocked in reload_wait" in report
+
+
+# ---- graftlint: lineage stamps must be joinable --------------------------
+
+
+def test_lint_rule_flags_untraceable_window_spans():
+    from scripts.graftlint.rules_metrics import (
+        find_untraced_window_spans,
+    )
+
+    bad = ast.parse(
+        "events.emit(events.WINDOW_SPAN, phase='train')\n"
+        "events.emit(events.WINDOW_SPAN, window_id=wid)\n"
+        "events.emit(events.WINDOW_SPAN, window_id=wid, phase=p)\n"
+        "events.emit(events.WINDOW_SPAN, window_id=wid,"
+        " phase='warp')\n"
+        "events.emit(events.WINDOW_SPAN, window_id=wid,"
+        " phase='train', reason=why)\n"
+        "events.emit(events.WINDOW_SPAN, window_id=wid,"
+        " phase='train', reason='bogus')\n"
+    )
+    messages = [m for _, m in find_untraced_window_spans(bad)]
+    assert len(messages) == 6
+    assert any("window_id" in m for m in messages)
+    assert any("must carry phase=" in m for m in messages)
+    assert any("computed value" in m for m in messages)
+    assert any("'warp'" in m for m in messages)
+    assert any("'bogus'" in m for m in messages)
+
+    good = ast.parse(
+        "events.emit(events.WINDOW_SPAN, window_id=w.window_id,"
+        " phase='ingest_wait', reason='sealed', at_unix_s=t)\n"
+        "events.emit(events.OTHER_EVENT, whatever=1)\n"
+    )
+    assert list(find_untraced_window_spans(good)) == []
+
+
+def test_window_span_production_sites_pass_the_lint_rule():
+    from scripts.graftlint.rules_metrics import (
+        find_untraced_window_spans,
+    )
+
+    for path in (
+        "elasticdl_tpu/data/reader/stream_reader.py",
+        "elasticdl_tpu/master/task_manager.py",
+        "elasticdl_tpu/online/pipeline.py",
+    ):
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        assert list(find_untraced_window_spans(tree)) == [], path

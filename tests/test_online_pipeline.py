@@ -9,7 +9,7 @@ line and stream-lag coverage from the snapshot."""
 import numpy as np
 import pytest
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import events, faults
 from elasticdl_tpu.common.faults import FaultRegistry, FaultSpec
 from elasticdl_tpu.client.slo import render_slo
 from elasticdl_tpu.client.top import render as top_render
@@ -134,6 +134,14 @@ def test_chaos_replay_is_byte_identical():
     assert summary_a["windows_released"] == summary_a["windows_trained"]
     assert summary_a["handoffs"] >= 1
     assert summary_a["handoff_faults"] == 1
+    # the lineage acceptance gate (docs/OBSERVABILITY.md "Window
+    # lineage"): records ride the byte-compared canonical trace, the
+    # buffer-wiped replayed window keeps its ORIGINAL ingest stamp, and
+    # the phase sums reconcile against measured e2e staleness
+    assert summary_a["lineage_windows"] >= 1
+    assert summary_a["lineage_replayed"] >= 1
+    assert summary_a["replayed_original_ingest"]
+    assert summary_a["lineage_reconcile"]["within_5pct"]
 
 
 def test_three_worker_pipeline_survives_kill_and_master_restart(
@@ -267,6 +275,13 @@ def test_online_summary_matches_script():
     assert summary["windows_armed"] >= summary["windows_trained"]
     assert summary["windows_lost"] == 0
     assert summary["handoffs"] == 0  # single-worker smoke: no handoffs
+    # lineage keys behind freshness_budget_worst_phase= /
+    # lineage_windows=: the worst phase is either a real phase name or
+    # the "-" placeholder when no window finished tracing yet
+    assert summary["lineage_windows"] >= 0
+    assert (summary["freshness_budget_worst_phase"] == "-"
+            or summary["freshness_budget_worst_phase"]
+            in events.WINDOW_PHASES)
 
 
 def test_backpressure_slows_poll_cadence_and_recovers(spec, tmp_path):
