@@ -89,6 +89,22 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     slo_parser.set_defaults(func="slo")
 
+    programs_parser = subparsers.add_parser(
+        "programs",
+        help="XLA program observatory (compiles, retraces, cost ledger, "
+        "live MFU) from any role's /varz endpoint",
+    )
+    programs_parser.add_argument(
+        "varz_addr",
+        help="telemetry address of any role: host:port or http URL "
+        "(--telemetry_port of a master, worker, or serving replica)",
+    )
+    programs_parser.add_argument(
+        "--json", action="store_true",
+        help="dump the raw program ledger as JSON instead of the table",
+    )
+    programs_parser.set_defaults(func="programs")
+
     trace_parser = subparsers.add_parser(
         "trace",
         help="convert an --event_log JSONL to Chrome trace JSON "
@@ -163,6 +179,10 @@ def main(argv=None) -> int:
         from elasticdl_tpu.client.slo import slo
 
         return slo(args)
+    if args.func == "programs":
+        from elasticdl_tpu.client.programs import programs
+
+        return programs(args)
     if args.func == "trace":
         from elasticdl_tpu.client.trace import trace
 

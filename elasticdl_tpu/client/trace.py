@@ -337,6 +337,63 @@ def build_chrome_trace(evts: List[dict]) -> dict:
                 })
                 cursor += seconds
 
+    # XLA compiles -> one slice per program_compiled event on the
+    # "programs" process track (one thread row per program name); the
+    # event stamps the END of the compile and carries its wall seconds,
+    # so the slice is laid back from the stamp.  Recompile storms show
+    # as flagged instants on the storming program's row.
+    compile_evts = [
+        e for e in evts
+        if e.get("event") in (events.PROGRAM_COMPILED,
+                              events.RECOMPILE_STORM)
+        and e.get("program")
+    ]
+    if compile_evts:
+        prog_pid = 5
+        out.append({
+            "ph": "M", "name": "process_name", "pid": prog_pid, "tid": 0,
+            "args": {"name": "programs"},
+        })
+        prog_tids = {
+            name: tid for tid, name in enumerate(
+                sorted({str(e["program"]) for e in compile_evts}), 1
+            )
+        }
+        for name, tid in sorted(prog_tids.items()):
+            out.append({
+                "ph": "M", "name": "thread_name", "pid": prog_pid,
+                "tid": tid, "args": {"name": name},
+            })
+        for e in compile_evts:
+            name = str(e["program"])
+            tid = prog_tids[name]
+            ts = float(e["ts"])
+            if e["event"] == events.RECOMPILE_STORM:
+                out.append({
+                    "ph": "i", "name": f"recompile storm: {name}",
+                    "cat": "compile", "s": "g", "pid": prog_pid,
+                    "tid": tid, "ts": _us(ts, t0),
+                    "args": {
+                        "program": name,
+                        "signatures": e.get("signatures"),
+                        "budget": e.get("budget"),
+                    },
+                })
+                continue
+            dur = float(e.get("seconds", 0.0))
+            args = {
+                k: e[k]
+                for k in ("program", "signature", "flops", "bytes",
+                          "signatures")
+                if k in e
+            }
+            out.append({
+                "ph": "X", "name": f"compile {name}", "cat": "compile",
+                "pid": prog_pid, "tid": tid,
+                "ts": _us(ts - dur, t0), "dur": round(dur * 1e6, 3),
+                "args": args,
+            })
+
     # Point events + recovery outage slices.
     for e in evts:
         name = e.get("event")
@@ -474,6 +531,39 @@ def summarize(evts: List[dict], slowest_k: int = 5) -> str:
                 f"  {request_id}: {span['reason']}"
                 + (f" code={span['code']}" if "code" in span else "")
                 + (f" error={span['error']}" if "error" in span else "")
+            )
+
+    # XLA compile summary (program_compiled events): where trace/compile
+    # wall time went, per program, plus any storms.
+    compiles: Dict[str, List[float]] = {}
+    storms: Dict[str, int] = {}
+    for e in evts:
+        if e.get("event") == events.PROGRAM_COMPILED and e.get("program"):
+            compiles.setdefault(str(e["program"]), []).append(
+                float(e.get("seconds", 0.0))
+            )
+        elif (e.get("event") == events.RECOMPILE_STORM
+                and e.get("program")):
+            storms[str(e["program"])] = storms.get(str(e["program"]), 0) + 1
+    if compiles:
+        lines.append("")
+        lines.append(
+            "xla compiles: {n} across {p} programs, "
+            "{s:.3f}s total".format(
+                n=sum(len(v) for v in compiles.values()),
+                p=len(compiles),
+                s=sum(sum(v) for v in compiles.values()),
+            )
+        )
+        for name in sorted(compiles, key=lambda n: -sum(compiles[n])):
+            vals = compiles[name]
+            storm_text = (
+                f"  STORMS={storms[name]}" if name in storms else ""
+            )
+            lines.append(
+                f"  {name:<24} {len(vals):3d} compiles  "
+                f"{sum(vals):8.3f}s total  "
+                f"{max(vals):7.3f}s max{storm_text}"
             )
 
     stragglers = [

@@ -60,6 +60,8 @@ STREAM_WINDOW_RESTORED = "stream_window_restored"  # un-acked replayed
 STORE_SHARD_HANDOFF = "store_shard_handoff"  # row range moved to successor
 SERVING_SCALE = "serving_scale"    # serving policy engine scaled the fleet
 WINDOW_SPAN = "window_span"        # one window-lineage phase stamp
+PROGRAM_COMPILED = "program_compiled"  # a registered XLA program compiled
+RECOMPILE_STORM = "recompile_storm"    # a program blew its signature budget
 
 #: Every event name this stream may carry.  `emit()` callers must pass
 #: one of these constants — scripts/check_metric_names.py rejects string
@@ -74,7 +76,7 @@ VOCABULARY = frozenset({
     INCIDENT_CAPTURED, STORE_GROWN, STORE_TIER_SWAPPED,
     STREAM_WINDOW_SEALED, STREAM_WINDOW_ARMED, STREAM_WINDOW_DROPPED,
     STREAM_WINDOW_RELEASED, STREAM_WINDOW_RESTORED, STORE_SHARD_HANDOFF,
-    SERVING_SCALE, WINDOW_SPAN,
+    SERVING_SCALE, WINDOW_SPAN, PROGRAM_COMPILED, RECOMPILE_STORM,
 })
 
 #: Closed vocabularies for the `action` / `reason` fields every
@@ -144,7 +146,7 @@ WINDOW_REASONS = frozenset({
 #: manifest draws from this set.
 INCIDENT_TRIGGERS = frozenset({
     "slo_breach", "policy_eviction", "reload_refused", "manual",
-    "tier1_failure", "window_dropped",
+    "tier1_failure", "window_dropped", "recompile_storm",
 })
 
 _lock = threading.Lock()

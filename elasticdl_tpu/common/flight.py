@@ -72,6 +72,10 @@ DECISION_EVENTS = frozenset({
     events.SLO_BREACH,
     events.SLO_RECOVERED,
     events.SERVING_SCALE,
+    # recompile_storm carries only deterministic fields (program,
+    # signature count, budget) — unlike program_compiled, whose wall
+    # seconds would break byte-stable bundles, so that one stays out.
+    events.RECOMPILE_STORM,
 })
 
 
@@ -108,11 +112,19 @@ class FlightRecorder:
         max_bundles: int = 8,
         snapshot_fn: Optional[Callable[[], dict]] = None,
         history=None,
+        program_registry=None,
     ):
         self._dir = incident_dir or None
         self._max_bundles = max(1, int(max_bundles))
         self._snapshot_fn = snapshot_fn
         self._history = history
+        self._program_registry = program_registry
+        if program_registry is not None:
+            # registry storm hooks run with no locks held (the
+            # dispatching thread, after the ledger lock is released),
+            # so an immediate pend+flush is a safe point — same
+            # contract as the SLO evaluator's on_breach.
+            program_registry.set_on_storm(self.storm)
         capacity = max(1, int(ring_capacity))
         self._spans: deque = deque(maxlen=capacity)
         self._decisions: deque = deque(maxlen=capacity)
@@ -177,6 +189,15 @@ class FlightRecorder:
                     ("window_dropped", record.get("window")),
                     record,
                 )
+            elif event == events.RECOMPILE_STORM:
+                # one bundle per storming program: the per-program key
+                # plus _armed_out dedupe means a storm that keeps
+                # retracing does not spam the incident dir
+                self._pend_locked(
+                    "recompile_storm",
+                    ("recompile_storm", record.get("program")),
+                    record,
+                )
 
     def _pend_locked(self, trigger: str, key: tuple,
                      evidence: dict) -> None:
@@ -197,6 +218,18 @@ class FlightRecorder:
         with self._lock:
             self._pend_locked(
                 "slo_breach", ("slo_breach", decision.get("slo")), decision
+            )
+        return self.flush()
+
+    def storm(self, record: dict) -> List[str]:
+        """ProgramRegistry `on_storm` wiring: queue (deduped against
+        the tap's copy of the same storm event) and capture in the same
+        tick — the hook runs with no registry locks held."""
+        with self._lock:
+            self._pend_locked(
+                "recompile_storm",
+                ("recompile_storm", record.get("program")),
+                record,
             )
         return self.flush()
 
@@ -238,6 +271,10 @@ class FlightRecorder:
             }
             if self._history is not None:
                 sections["history"] = _stable(self._history.snapshot())
+            if self._program_registry is not None:
+                sections["programs"] = _stable(
+                    self._program_registry.forensics()
+                )
             if self._snapshot_fn is not None:
                 sections["master"] = _stable(self._snapshot_fn())
             os.makedirs(path, exist_ok=True)

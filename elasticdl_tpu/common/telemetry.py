@@ -77,6 +77,18 @@ class TelemetryServer:
                 extra = self._varz_fn() or {}
             except Exception as exc:
                 extra = {"varz_error": str(exc)}
+        # every role carries the program observatory ledger: the
+        # process-wide registry of compiled XLA programs (the surface
+        # `elasticdl programs` and the `top` programs line scrape)
+        if "programs" not in extra:
+            try:
+                from elasticdl_tpu.common import programs
+
+                extra["programs"] = (
+                    programs.default_program_registry().summary()
+                )
+            except Exception as exc:
+                extra["programs_error"] = str(exc)
         return metrics.varz(self._registries, role=self._role, extra=extra)
 
     # ---- lifecycle ------------------------------------------------------

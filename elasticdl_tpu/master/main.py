@@ -247,6 +247,9 @@ class Master:
         if history_interval > 0 or slo_interval > 0 or incident_dir:
             from elasticdl_tpu.common.flight import FlightRecorder
             from elasticdl_tpu.common.history import MetricHistory
+            from elasticdl_tpu.common.programs import (
+                default_program_registry,
+            )
             from elasticdl_tpu.common.slo import SloEvaluator, shipped_specs
 
             self.metric_history = MetricHistory(
@@ -266,6 +269,10 @@ class Master:
                 ),
                 snapshot_fn=self.snapshot,
                 history=self.metric_history,
+                # recompile storms pend an immediate capture through
+                # the registry's on_storm hook, and every bundle gains
+                # a programs.json ledger section
+                program_registry=default_program_registry(),
             ).install()
             self.slo_evaluator = SloEvaluator(
                 self.metric_history,

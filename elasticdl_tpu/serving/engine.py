@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from elasticdl_tpu.common import metrics as metrics_lib
+from elasticdl_tpu.common import programs
 from elasticdl_tpu.common.export import (
     SINGLE_FEATURE_KEY,
     feature_meta,
@@ -105,6 +106,7 @@ class ServingEngine:
         precompile: bool = True,
         state_template: Any = None,
         produced_unix_s: Optional[float] = None,
+        pad_to_bucket: bool = True,
     ):
         if not buckets or any(b <= 0 for b in buckets):
             raise ValueError(f"buckets must be positive: {buckets}")
@@ -118,6 +120,10 @@ class ServingEngine:
         self._feature_spec = dict(feature_spec)
         self._buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._single = set(self._feature_spec) == {SINGLE_FEATURE_KEY}
+        # storm-drill seam: disabling bucket padding makes every distinct
+        # request size a fresh trace, driving the registered program past
+        # its signature budget (tests only — production always pads)
+        self._pad_to_bucket = bool(pad_to_bucket)
         self._has_train = model_has_train_kwarg(model)
         self._lock = threading.Lock()
         # phase-timing clock (docs/OBSERVABILITY.md "Request tracing");
@@ -152,7 +158,15 @@ class ServingEngine:
             with mesh_lib.export_mode():
                 return self._model.apply(variables, x, **kwargs)
 
-        self._forward = jax.jit(forward)
+        # Registered program (common/programs.py): every bucket trace is
+        # a recorded compile in the process-wide ledger, and the bucket
+        # count IS the declared signature budget — one more distinct
+        # shape than the buckets within the storm window means requests
+        # are missing the buckets (a recompile storm).
+        self._forward = programs.registered_jit(
+            "serving_forward", forward,
+            signature_budget=len(self._buckets),
+        )
         if precompile:
             self.warmup()
 
@@ -387,6 +401,8 @@ class ServingEngine:
                 f"batch of {rows} rows exceeds largest bucket "
                 f"{self.max_bucket}"
             )
+        if not self._pad_to_bucket:
+            bucket = rows
         t0 = self.clock()
         padded = {}
         for name, arr in features.items():

@@ -49,6 +49,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from elasticdl_tpu.common import programs
 from elasticdl_tpu.layers.arena import dequantize_rows, quantize_rows
 from elasticdl_tpu.worker.trainer import run_device_serialized
 
@@ -109,7 +110,6 @@ def _gather_program(layout, cache_dtype: str):
 
     if cache_dtype == "int8":
 
-        @jax.jit
         def gather(params, quant, idx):
             # dequant(codes, scales) + carrier: exact even mid-step (the
             # carrier is zero BETWEEN steps — fold_quantized_updates —
@@ -125,14 +125,13 @@ def _gather_program(layout, cache_dtype: str):
                 )
             return tuple(out)
 
-        return gather
+        return programs.registered_jit("store_gather", gather)
 
-    @jax.jit
     def gather(params, quant, idx):
         del quant
         return tuple(_get_in(params, path)[idx] for path in paths)
 
-    return gather
+    return programs.registered_jit("store_gather", gather)
 
 
 def _quant_collection(state, cache_dtype: str):
@@ -302,7 +301,6 @@ def apply_admissions(state, param_paths: Dict[str, Tuple[str, ...]],
 def _admit_program(layout, cache_dtype: str):
     paths = tuple(path for _, path in layout)
 
-    @jax.jit
     def admit(params, quant, opt_state, idx, vals):
         for path, v in zip(paths, vals):
             if cache_dtype == "int8":
@@ -354,4 +352,4 @@ def _admit_program(layout, cache_dtype: str):
         )
         return params, quant, opt_state
 
-    return admit
+    return programs.registered_jit("store_admit", admit)

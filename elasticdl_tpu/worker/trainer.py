@@ -29,6 +29,7 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from elasticdl_tpu.common import programs
 from elasticdl_tpu.common.log_utils import get_logger
 from elasticdl_tpu.layers.arena import fold_quantized_updates
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -219,7 +220,11 @@ class Trainer:
 
         shapes = jax.eval_shape(make)
         shardings = self.state_sharding(shapes)
-        return run_device_serialized(jax.jit(make, out_shardings=shardings))
+        return run_device_serialized(
+            programs.registered_jit(
+                "worker_init_state", make, out_shardings=shardings
+            )
+        )
 
     def state_sharding(self, state):
         """Sharding tree for the train state: replicated by default;
@@ -355,9 +360,15 @@ class Trainer:
 
         # Shardings: batch split on `data`; XLA inserts the gradient
         # all-reduce from the sharding propagation (no explicit psum).
-        self.train_step = jax.jit(train_step, donate_argnums=(0,))
-        self.train_step_many = jax.jit(train_step_many, donate_argnums=(0,))
-        self.eval_step = jax.jit(eval_step)
+        self.train_step = programs.registered_jit(
+            "worker_train_step", train_step, donate_argnums=(0,)
+        )
+        self.train_step_many = programs.registered_jit(
+            "worker_train_step_many", train_step_many, donate_argnums=(0,)
+        )
+        self.eval_step = programs.registered_jit(
+            "worker_eval_step", eval_step
+        )
 
     # ---- host-side helpers --------------------------------------------
 
@@ -663,7 +674,7 @@ class Trainer:
                 ),
                 sample_batch,
             )
-            warm.train_step.lower(abstract_state, abstract_batch).compile()
+            warm.train_step.aot_compile(abstract_state, abstract_batch)
         finally:
             mesh_lib.set_thread_mesh(prev_mesh)
         logger.info(
@@ -726,7 +737,9 @@ class Trainer:
                 )
                 return out.step, anchor
 
-            fused = cache[iters] = jax.jit(multi)
+            fused = cache[iters] = programs.registered_jit(
+                "worker_timed_fused", multi
+            )
         # warm once per (iters, shapes): compile + first-exec costs; later
         # repeats (bench medians) skip it — re-warming every repeat would
         # double the device work under a wall-clock-budgeted driver

@@ -1,0 +1,269 @@
+"""Bench trajectory comparison + the CI COST_SUMMARY line.
+
+The roadmap driver archives each round's bench output as
+``BENCH_r0*.json``: ``{n, cmd, rc, tail, parsed}`` where ``parsed`` is
+the first metric JSON line when the driver managed to parse one and
+``None`` otherwise (the ``tail`` is capped, so a long round's first
+metric line can be truncated mid-object).  This module re-parses every
+round — ``parsed`` when present, complete JSON lines out of ``tail``
+when not, and a fragment-recovery pass for truncated lines (the metric
+name's surviving suffix is resolved against names seen in full rounds)
+— and renders the per-metric trajectory across rounds:
+
+    python -m scripts.bench_compare
+
+The regression verdict compares each metric's LAST round against the
+round immediately before it (adjacent rounds only: early rounds timed
+per-call async dispatch and over-report by large factors — bench.py's
+own comments mark them non-comparable, so "last vs best-ever" would
+always cry wolf).  A drop below ``--threshold`` (default 0.5x) exits 1.
+
+``--cost-summary`` prints the one machine-readable line
+``scripts/run_tests.sh`` emits next to STORE_SUMMARY/ONLINE_SUMMARY:
+
+    COST_SUMMARY programs=<n> recompiles=<n> mfu=<f> bytes_per_step=<b>
+
+``programs``/``recompiles`` come from a live in-process probe of the
+program observatory (common/programs.py): one registered program
+dispatched at two shapes must record exactly 2 compiles / 2 signatures
+(recompiles = compiles beyond the first = 1), so a registry-counting
+regression shows up in CI in under a second, without a TPU and without
+running bench.  ``mfu``/``bytes_per_step`` are scraped from the newest
+archived round that carries them (regex-tolerant of truncated tails);
+``-`` when no round does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# a metric/value pair whose line head was truncated away: the name's
+# surviving suffix, immediately followed by the value field
+_FRAGMENT = re.compile(
+    r'([A-Za-z0-9_]+)"\s*,\s*"value"\s*:\s*([0-9][0-9.eE+-]*)'
+)
+
+
+def load_round(path: str) -> dict:
+    """One archived round -> {n, rc, metrics, fragments}.  `metrics`
+    maps metric name -> value from `parsed` plus every complete JSON
+    line in `tail`; `fragments` holds (name_suffix, value) pairs
+    recovered from truncated lines, resolved later against the full
+    metric names other rounds saw."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    metrics: Dict[str, float] = {}
+    fragments: List[Tuple[str, float]] = []
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("metric"):
+        metrics[str(parsed["metric"])] = float(parsed.get("value", 0.0))
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            if '"value"' in line:
+                for name, value in _FRAGMENT.findall(line):
+                    fragments.append((name, float(value)))
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            for name, value in _FRAGMENT.findall(line):
+                fragments.append((name, float(value)))
+            continue
+        name = obj.get("metric") or obj.get("bench")
+        if name and "value" in obj:
+            metrics.setdefault(str(name), float(obj["value"]))
+    return {
+        "n": int(doc.get("n", 0)),
+        "rc": int(doc.get("rc", 0)),
+        "metrics": metrics,
+        "fragments": fragments,
+        "tail": str(doc.get("tail", "")),
+    }
+
+
+def load_rounds(pattern: str) -> List[dict]:
+    rounds = [load_round(path) for path in sorted(glob.glob(pattern))]
+    rounds.sort(key=lambda r: r["n"])
+    # resolve truncated-name fragments against the full names any round
+    # recorded; an unresolvable fragment keeps its suffix as the name
+    # (still comparable round-to-round, since truncation is stable)
+    known = sorted(
+        {name for r in rounds for name in r["metrics"]},
+        key=len, reverse=True,
+    )
+    for r in rounds:
+        for suffix, value in r["fragments"]:
+            name = next(
+                (k for k in known if k.endswith(suffix)), suffix
+            )
+            r["metrics"].setdefault(name, value)
+    return rounds
+
+
+def trajectory(rounds: List[dict]) -> Dict[str, List[Tuple[int, float]]]:
+    """metric -> [(round_n, value), ...] in round order."""
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    for r in rounds:
+        for name, value in r["metrics"].items():
+            out.setdefault(name, []).append((r["n"], value))
+    return out
+
+
+def regressions(
+    traj: Dict[str, List[Tuple[int, float]]], threshold: float
+) -> List[dict]:
+    """Adjacent-round verdict: a metric regressed when its newest value
+    fell below threshold x the round before it."""
+    out = []
+    for name, points in sorted(traj.items()):
+        if len(points) < 2:
+            continue
+        (prev_n, prev), (last_n, last) = points[-2], points[-1]
+        if prev > 0 and last < threshold * prev:
+            out.append({
+                "metric": name,
+                "prev_round": prev_n, "prev": prev,
+                "last_round": last_n, "last": last,
+                "ratio": last / prev,
+            })
+    return out
+
+
+def render(rounds: List[dict], traj: Dict[str, List[Tuple[int, float]]],
+           threshold: float) -> str:
+    ns = [r["n"] for r in rounds]
+    lines = [
+        "bench trajectory — {n} rounds, regression threshold "
+        "{t:g}x vs previous round".format(n=len(rounds), t=threshold),
+        "metric".ljust(44) + "".join(f"r{n:02d}".rjust(12) for n in ns),
+    ]
+    for name, points in sorted(traj.items()):
+        by_n = dict(points)
+        lines.append(
+            name[:43].ljust(44)
+            + "".join(
+                (f"{by_n[n]:.4g}" if n in by_n else "-").rjust(12)
+                for n in ns
+            )
+        )
+    bad = [r for r in rounds if r["rc"] != 0]
+    if bad:
+        lines.append(
+            "nonzero-rc rounds: "
+            + " ".join(f"r{r['n']:02d}(rc={r['rc']})" for r in bad)
+        )
+    for reg in regressions(traj, threshold):
+        lines.append(
+            "REGRESSION {m}: r{a:02d} {p:.4g} -> r{b:02d} {l:.4g} "
+            "({r:.2f}x)".format(
+                m=reg["metric"], a=reg["prev_round"], p=reg["prev"],
+                b=reg["last_round"], l=reg["last"], r=reg["ratio"],
+            )
+        )
+    return "\n".join(lines)
+
+
+# ---- COST_SUMMARY ------------------------------------------------------
+
+def _registry_probe() -> Tuple[int, int]:
+    """(programs, recompiles) from a live ProgramRegistry probe: one
+    registered program dispatched at two shapes, repeated at the first
+    — exactly 2 compiles, 2 signatures, so recompiles (compiles beyond
+    the first per program) is exactly 1 when counting is healthy."""
+    import numpy as np
+
+    from elasticdl_tpu.common import metrics as metrics_lib
+    from elasticdl_tpu.common import programs
+
+    registry = programs.ProgramRegistry(
+        metrics=metrics_lib.MetricsRegistry()
+    )
+    probe = programs.registered_jit(
+        "cost_probe", lambda x: (x * x).sum(), registry=registry
+    )
+    probe(np.ones((4, 4), np.float32))
+    probe(np.ones((8, 4), np.float32))
+    probe(np.ones((4, 4), np.float32))  # cache hit: no third compile
+    led = registry.ledger()
+    compiles = sum(rec["compiles"] for rec in led.values())
+    active = sum(1 for rec in led.values() if rec["compiles"])
+    return active, compiles - active
+
+
+_SCRAPE = {
+    "mfu": re.compile(r'"mfu"\s*:\s*([0-9][0-9.eE+-]*)'),
+    "bytes_per_step": re.compile(
+        r'"step_bytes_accessed_xla_costmodel"\s*:\s*([0-9][0-9.eE+-]*)'
+    ),
+}
+
+
+def cost_summary(rounds: List[dict]) -> str:
+    programs_n, recompiles = _registry_probe()
+    scraped = {"mfu": "-", "bytes_per_step": "-"}
+    for r in reversed(rounds):
+        for key, pattern in _SCRAPE.items():
+            if scraped[key] == "-":
+                match = pattern.search(r["tail"])
+                if match:
+                    scraped[key] = match.group(1)
+        if all(v != "-" for v in scraped.values()):
+            break
+    return (
+        f"COST_SUMMARY programs={programs_n} recompiles={recompiles} "
+        f"mfu={scraped['mfu']} bytes_per_step={scraped['bytes_per_step']}"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_compare",
+        description="bench round trajectory, regression verdict, and "
+        "the CI COST_SUMMARY line",
+    )
+    parser.add_argument(
+        "--rounds-glob", default="BENCH_r0*.json",
+        help="glob for archived round files (driver format)",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.5,
+        help="regression = last < threshold * previous round",
+    )
+    parser.add_argument(
+        "--cost-summary", action="store_true",
+        help="print only the COST_SUMMARY line (run_tests.sh mode)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="dump the trajectory as JSON")
+    args = parser.parse_args(argv)
+
+    rounds = load_rounds(args.rounds_glob)
+    if args.cost_summary:
+        print(cost_summary(rounds))
+        return 0
+    if not rounds:
+        print(f"bench_compare: no rounds match {args.rounds_glob!r}",
+              file=sys.stderr)
+        return 1
+    traj = trajectory(rounds)
+    regs = regressions(traj, args.threshold)
+    if args.json:
+        print(json.dumps(
+            {"trajectory": {k: v for k, v in sorted(traj.items())},
+             "regressions": regs},
+            indent=2, sort_keys=True,
+        ))
+    else:
+        print(render(rounds, traj, args.threshold))
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ from scripts.graftlint import (  # noqa: F401,E402
     rules_ledger,
     rules_locks,
     rules_metrics,
+    rules_programs,
     rules_quant,
     rules_retries,
 )

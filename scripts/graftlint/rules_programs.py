@@ -1,0 +1,110 @@
+"""GL-PROGRAM: every XLA program in `elasticdl_tpu/` flows through the
+program observatory (common/programs.py).
+
+The observatory's whole value — compile telemetry, per-program
+flop/byte ledger, retrace-storm incidents — holds only while it sees
+EVERY jitted entry point.  One direct `jax.jit` call is an invisible
+program: its compiles, retraces, and cost vanish from `elasticdl
+programs`, from the /varz MFU join, and from recompile-storm incident
+bundles (the ISSUE-20 failure mode: a bucket-missing serving path
+retracing per request with no storm ever detected, because the compile
+counter lived elsewhere).
+
+Findings, in any module under `elasticdl_tpu/` (the registry module
+itself is allowlisted — it is the one place allowed to touch jax.jit):
+
+- any reference to `jax.jit` — call, decorator, or alias (aliasing it
+  out is the trivial evasion);
+- `from jax import jit`;
+- any `.lower(...)` call WITH arguments — the AOT lowering entry point
+  (`jitted.lower(state, batch).compile()` builds an executable the
+  registry never sees; use `RegisteredProgram.aot_compile()` /
+  `.cost_for()`).  Zero-argument `.lower()` is `str.lower` and is not
+  flagged.
+
+Escapes: register through `programs.registered_jit(name, fn, ...)` or
+report an external executable with `programs.register_compiled`; a
+`# graftlint: disable=GL-PROGRAM` line suppression needs a comment
+saying why the program is exempt from observation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet
+
+from scripts.graftlint.core import Finding, ParsedFile, Rule, register
+
+RULE_ID = "GL-PROGRAM"
+
+#: The one module allowed to call jax.jit / .lower(): the registry.
+DEFAULT_ALLOWLIST: FrozenSet[str] = frozenset({
+    "elasticdl_tpu/common/programs.py",
+})
+
+
+def find_unregistered_programs(tree: ast.AST):
+    """Yield (lineno, message) for jax.jit references and argful
+    .lower() calls."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        ):
+            yield (
+                node.lineno,
+                "direct jax.jit: this program is invisible to the "
+                "observatory (no compile telemetry, no cost ledger, no "
+                "recompile-storm detection) — register it with "
+                "common/programs.registered_jit(name, fn, ...)",
+            )
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "jit":
+                    yield (
+                        node.lineno,
+                        "`from jax import jit` evades the program "
+                        "observatory — register programs with "
+                        "common/programs.registered_jit(name, fn, ...)",
+                    )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "lower"
+            and (node.args or node.keywords)
+        ):
+            yield (
+                node.lineno,
+                "argful .lower(): an AOT executable built outside the "
+                "observatory records no compile and no cost — use "
+                "RegisteredProgram.aot_compile()/.cost_for() (zero-arg "
+                ".lower() is str.lower and is fine)",
+            )
+
+
+class ProgramsRule(Rule):
+    id = RULE_ID
+    title = "jitted programs register through common/programs.py"
+    rationale = (
+        "one direct jax.jit call makes a program invisible to compile "
+        "telemetry, the flop/byte ledger, and recompile-storm "
+        "incidents — the observatory only works at full coverage"
+    )
+
+    def __init__(self, allowlist: FrozenSet[str] = DEFAULT_ALLOWLIST):
+        self.allowlist = frozenset(allowlist)
+
+    def applies(self, pf: ParsedFile) -> bool:
+        return (
+            pf.rel.startswith("elasticdl_tpu/")
+            and pf.rel not in self.allowlist
+        )
+
+    def check(self, pf: ParsedFile):
+        for lineno, message in find_unregistered_programs(pf.tree):
+            yield Finding(pf.rel, lineno, self.id, message)
+
+
+register(ProgramsRule())

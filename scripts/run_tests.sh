@@ -3,12 +3,13 @@
 # no device), then unit + in-process integration tests on a virtual
 # 8-device CPU mesh, then the native-component build.
 #
-# Always ends with three machine-readable lines:
+# Always ends with four machine-readable lines:
 #   STORE_SUMMARY hit_rate=<r> growth_rows=<n> cache_dtype=<d> \
 #       device_cache_bytes=<b> int8_bytes_reduction=<x> \
 #       per_chip_cache_bytes=<b/8>
 #   ONLINE_SUMMARY train_eps=<e> qps=<q> staleness_p99_s=<s> burn=<b> \
 #       freshness_budget_worst_phase=<p> lineage_windows=<n>
+#   COST_SUMMARY programs=<n> recompiles=<n> mfu=<f> bytes_per_step=<b>
 #   TIER1_SUMMARY passed=<N> wall_s=<S> lint_findings=<L> status=<ok|fail>
 # so CI (and the roadmap driver) can scrape the tier-1 outcome — and the
 # tiered store's cache efficacy (docs/PERF.md "Tiered embedding store")
@@ -77,5 +78,10 @@ python -m scripts.store_summary || true
 # predicts, a few seconds on CPU; non-fatal here — the matching test
 # in tests/test_online_pipeline.py owns the hard assertions.
 python -m scripts.online_summary || true
+# Program-observatory cost line (docs/OBSERVABILITY.md "Program
+# observatory"): a live registry probe (compile/retrace counting) plus
+# the newest archived bench round's cost-model numbers; non-fatal —
+# tests/test_programs.py owns the hard assertions.
+python -m scripts.bench_compare --cost-summary || true
 echo "TIER1_SUMMARY passed=${passed} wall_s=${wall_s} lint_findings=${lint_findings} status=${status}"
 exit "$rc"

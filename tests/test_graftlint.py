@@ -31,13 +31,15 @@ from scripts.graftlint import (  # noqa: E402
     rules_ledger,
     rules_locks,
     rules_metrics,
+    rules_programs,
     rules_quant,
     rules_retries,
 )
 
 ALL_IDS = {
     "GL-BOUNDARY", "GL-CLOCK", "GL-DONATE", "GL-DRIFT",
-    "GL-LEDGER", "GL-LOCK", "GL-METRIC", "GL-QUANT", "GL-RETRY",
+    "GL-LEDGER", "GL-LOCK", "GL-METRIC", "GL-PROGRAM", "GL-QUANT",
+    "GL-RETRY",
 }
 
 
@@ -48,7 +50,7 @@ def _ids(findings):
 # ---- framework ----------------------------------------------------------
 
 
-def test_registry_has_all_nine_rules():
+def test_registry_has_all_ten_rules():
     assert set(core.all_rules()) == ALL_IDS
 
 
@@ -615,6 +617,74 @@ def test_quant_other_store_modules_still_covered():
     found = check_source(src, "elasticdl_tpu/store/tiered.py",
                          [rules_quant.QuantRule()])
     assert _ids(found) == ["GL-QUANT"]
+
+
+# ---- GL-PROGRAM ---------------------------------------------------------
+
+NAKED_JIT = "import jax\nstep = jax.jit(fn, donate_argnums=(0,))\n"
+
+
+def test_program_positive_direct_jit():
+    found = check_source(NAKED_JIT, "elasticdl_tpu/worker/x.py",
+                         [rules_programs.ProgramsRule()])
+    assert _ids(found) == ["GL-PROGRAM"]
+    assert "registered_jit" in found[0].message
+
+
+def test_program_positive_jit_decorator_and_alias():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+        "sneaky = jax.jit\n"
+    )
+    found = check_source(src, "elasticdl_tpu/store/x.py",
+                         [rules_programs.ProgramsRule()])
+    assert _ids(found) == ["GL-PROGRAM", "GL-PROGRAM"]
+
+
+def test_program_positive_from_import_and_argful_lower():
+    src = (
+        "from jax import jit\n"
+        "cost = step.lower(state, batch).compile().cost_analysis()\n"
+    )
+    found = check_source(src, "elasticdl_tpu/worker/x.py",
+                         [rules_programs.ProgramsRule()])
+    assert _ids(found) == ["GL-PROGRAM", "GL-PROGRAM"]
+    assert any("aot_compile" in f.message for f in found)
+
+
+def test_program_zero_arg_lower_is_str_lower():
+    # `name.lower()` is string casing, not AOT lowering
+    src = "key = program_name.lower()\n"
+    assert not check_source(src, "elasticdl_tpu/worker/x.py",
+                            [rules_programs.ProgramsRule()])
+
+
+def test_program_registry_module_is_allowlisted():
+    assert "elasticdl_tpu/common/programs.py" \
+        in rules_programs.DEFAULT_ALLOWLIST
+    assert not check_source(
+        NAKED_JIT, "elasticdl_tpu/common/programs.py",
+        [rules_programs.ProgramsRule()],
+    )
+
+
+def test_program_scoped_to_elasticdl_tpu():
+    # model_zoo / scripts are free to jit directly (bench and zoo
+    # models are not serving/training entry points)
+    assert not check_source(NAKED_JIT, "model_zoo/deepfm/x.py",
+                            [rules_programs.ProgramsRule()])
+
+
+def test_program_suppressed():
+    src = NAKED_JIT.replace(
+        "jax.jit(fn, donate_argnums=(0,))",
+        "jax.jit(fn)  # graftlint: disable=GL-PROGRAM",
+    )
+    assert not check_source(src, "elasticdl_tpu/worker/x.py",
+                            [rules_programs.ProgramsRule()])
 
 
 # ---- acceptance demos (ISSUE exit-1 criteria) ---------------------------
